@@ -1,0 +1,26 @@
+"""p2p_tpu — a TPU-native (JAX/XLA/Pallas) paired-image conditional-GAN framework.
+
+A ground-up reimplementation of the capability surface of the reference
+``Dev-Vault-Archived/p2p-pytorch`` repo (learned bit-depth compression + GAN
+restoration, pix2pix family), designed TPU-first:
+
+- NHWC layouts, bf16 compute / fp32 params, static shapes, everything jitted.
+- One compiled train step containing all network updates (G, D, C).
+- Parallelism via ``jax.sharding.Mesh`` axes ``(data, spatial, time)``:
+  data-parallel, GSPMD spatial sharding with conv halo exchange, and
+  temporal sequence parallelism — collectives ride ICI, inserted by XLA or
+  written explicitly in ``shard_map`` regions.
+- Pallas kernels for ops where XLA's defaults are weak (fused InstanceNorm).
+
+Subpackages:
+    core      mesh / config / dtype policy / rng
+    ops       quantizer (STE), pixel (un)shuffle, convs, norms, spectral norm
+    models    generators, discriminators, VGG feature extractor
+    losses    GAN / feature-matching / perceptual / metrics
+    data      dataset generation + input pipeline
+    train     train state, jitted step, schedules, checkpointing, loop
+    parallel  sharding rules, halo exchange, collectives
+    infer     batched generator inference
+"""
+
+__version__ = "0.1.0"

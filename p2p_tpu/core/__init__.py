@@ -1,0 +1,30 @@
+from p2p_tpu.core.config import (
+    Config,
+    DataConfig,
+    LossConfig,
+    ModelConfig,
+    OptimConfig,
+    ParallelConfig,
+    get_preset,
+    list_presets,
+)
+from p2p_tpu.core.dtypes import DTypePolicy, default_policy
+from p2p_tpu.core.mesh import MeshSpec, make_mesh, local_batch_size
+from p2p_tpu.core.rng import RngStream
+
+__all__ = [
+    "Config",
+    "DataConfig",
+    "LossConfig",
+    "ModelConfig",
+    "OptimConfig",
+    "ParallelConfig",
+    "get_preset",
+    "list_presets",
+    "DTypePolicy",
+    "default_policy",
+    "MeshSpec",
+    "make_mesh",
+    "local_batch_size",
+    "RngStream",
+]

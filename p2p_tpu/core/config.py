@@ -1,0 +1,238 @@
+"""Configuration system.
+
+The reference configures everything through 21 argparse flags plus a pile of
+hardcoded constants (SURVEY.md §5.6: dataset root, quantizer bits, loss
+weights 10/10/1, Num_D=3 ...). Here every knob is an explicit dataclass
+field, and the five BASELINE.json target configs are checked in as named
+presets retrievable via :func:`get_preset`.
+
+Reference flag parity (train.py:133-157) is kept by ``Config.from_flags`` in
+``p2p_tpu.cli.train``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from p2p_tpu.core.mesh import MeshSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    # Generator family: "expand" (reference ExpandNetwork transform-net,
+    # networks.py:447), "unet" (classic pix2pix U-Net), "pix2pixhd"
+    # (coarse-to-fine global+local), "resnet" (9-block ResnetGenerator,
+    # the commented alternative at networks.py:168).
+    generator: str = "expand"
+    input_nc: int = 3
+    output_nc: int = 3
+    ngf: int = 32            # reference ExpandNetwork base width (networks.py:460)
+    ndf: int = 64            # discriminator base width (networks.py:708)
+    n_blocks: int = 9        # residual blocks in expand/resnet G (networks.py:472)
+    # Discriminator: multiscale PatchGAN (networks.py:716). num_D=3,
+    # n_layers=3, spectral norm on inner convs, intermediate features kept
+    # for the feature-matching loss.
+    num_D: int = 3
+    n_layers_D: int = 3
+    use_spectral_norm: bool = True
+    get_interm_feat: bool = True
+    # Compression pre-filter (networks.py:201) + quantizer bits
+    # (hardcoded 3 at train.py:297).
+    use_compression_net: bool = True
+    quant_bits: int = 3
+    # Straight-through estimator through the quantizer. The reference has
+    # none (SURVEY Q2) so its net_c never learns; True implements the
+    # *intended* behavior, False is bug-compatible.
+    quant_ste: bool = True
+    # "batch" | "instance" | "pallas_instance"
+    norm: str = "batch"
+    init_type: str = "normal"   # normal | xavier | kaiming | orthogonal
+    init_gain: float = 0.02
+    # vid2vid temporal discriminator window (frames)
+    n_frames: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    gan_mode: str = "lsgan"          # lsgan | vanilla | hinge
+    lambda_feat: float = 10.0        # train.py:351
+    lambda_vgg: float = 10.0         # train.py:377
+    lambda_tv: float = 1.0           # train.py:378
+    lambda_l1: float = 0.0           # reference --lamb=10 but L1 is dead (Q3)
+    # Feed [-1,1] images to VGG un-normalized, as the reference does
+    # (networks.py:26 — no ImageNet mean/std). Changes loss scale; keep
+    # faithful by default.
+    vgg_imagenet_norm: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    lr: float = 2e-4                 # train.py:241-243
+    beta1: float = 0.5
+    beta2: float = 0.999
+    lr_policy: str = "lambda"        # lambda | step | plateau | cosine (networks.py:104)
+    niter: int = 100                 # epochs at constant lr
+    niter_decay: int = 100           # epochs of linear decay to 0
+    lr_decay_iters: int = 50         # step policy period
+    # Fix Q1: the reference's optimizer_c holds net_d's params so net_c
+    # never trains. True wires C's optimizer to C (intended behavior).
+    train_compression_net: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    root: str = "dataset"
+    dataset: str = "facades"
+    direction: str = "b2a"           # train.py:139
+    image_size: int = 256
+    image_width: Optional[int] = None  # None → square
+    batch_size: int = 1              # train.py:143
+    test_batch_size: int = 1
+    threads: int = 4
+    # Video clips for vid2vid-style configs
+    n_frames: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    mesh: MeshSpec = MeshSpec(data=-1, spatial=1, time=1)
+    # Sync batch-norm statistics across the data axis (pmean). At bs=1 per
+    # device this is the only way BatchNorm matches reference semantics.
+    sync_batchnorm: bool = True
+    # Remat (jax.checkpoint) the generator blocks to trade FLOPs for HBM.
+    remat: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    nepoch: int = 200
+    epoch_count: int = 1             # resume start epoch (train.py:137)
+    epoch_save: int = 20             # --epochsave
+    seed: int = 123                  # train.py:166
+    log_every: int = 50
+    checkpoint_dir: str = "checkpoint"
+    result_dir: str = "result"
+    eval_every_epoch: bool = True
+    mixed_precision: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    name: str = "default"
+    model: ModelConfig = ModelConfig()
+    loss: LossConfig = LossConfig()
+    optim: OptimConfig = OptimConfig()
+    data: DataConfig = DataConfig()
+    parallel: ParallelConfig = ParallelConfig()
+    train: TrainConfig = TrainConfig()
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def image_hw(self) -> Tuple[int, int]:
+        h = self.data.image_size
+        w = self.data.image_width or h
+        return h, w
+
+
+# ----------------------------------------------------------------------------
+# The five BASELINE.json target configs, checked in as presets.
+# ----------------------------------------------------------------------------
+
+_PRESETS = {}
+
+
+def _register(cfg: Config) -> Config:
+    _PRESETS[cfg.name] = cfg
+    return cfg
+
+
+# 1. facades 256×256 pix2pix (U-Net G + 70×70 PatchGAN D, bs=1)
+_register(
+    Config(
+        name="facades",
+        model=ModelConfig(generator="unet", ngf=64, num_D=1, n_layers_D=3,
+                          use_spectral_norm=False, use_compression_net=False),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        data=DataConfig(dataset="facades", image_size=256, batch_size=1),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+    )
+)
+
+# Reference-faithful config: ExpandNetwork + CompressionNetwork + multiscale D
+# with the exact loss surface of /root/reference/train.py.
+_register(
+    Config(
+        name="reference",
+        model=ModelConfig(generator="expand"),
+        loss=LossConfig(),
+        data=DataConfig(dataset="facades", image_size=256, batch_size=1),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+    )
+)
+
+# 2. edges2shoes 256×256, bs=64 data-parallel
+_register(
+    Config(
+        name="edges2shoes_dp",
+        model=ModelConfig(generator="unet", ngf=64, num_D=1, n_layers_D=3,
+                          use_spectral_norm=False, use_compression_net=False),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        data=DataConfig(dataset="edges2shoes", image_size=256, batch_size=64),
+        parallel=ParallelConfig(mesh=MeshSpec(data=-1)),
+    )
+)
+
+# 3. Cityscapes labels→photo 512×256 (GSPMD spatial shard)
+_register(
+    Config(
+        name="cityscapes_spatial",
+        model=ModelConfig(generator="resnet", ngf=64, norm="instance",
+                          use_compression_net=False),
+        loss=LossConfig(lambda_l1=0.0),
+        data=DataConfig(dataset="cityscapes", image_size=256, image_width=512,
+                        batch_size=4),
+        parallel=ParallelConfig(mesh=MeshSpec(data=-1, spatial=2)),
+    )
+)
+
+# 4. pix2pixHD multi-scale G/D at 1024×512 (Pallas InstanceNorm + conv)
+_register(
+    Config(
+        name="pix2pixhd",
+        model=ModelConfig(generator="pix2pixhd", ngf=64, norm="pallas_instance",
+                          num_D=3, n_layers_D=3, use_compression_net=False),
+        loss=LossConfig(lambda_feat=10.0, lambda_vgg=10.0, lambda_tv=0.0),
+        data=DataConfig(dataset="cityscapes_hd", image_size=512,
+                        image_width=1024, batch_size=1),
+        parallel=ParallelConfig(mesh=MeshSpec(data=-1, spatial=2), remat=True),
+    )
+)
+
+# 5. vid2vid 8-frame temporal discriminator (sequence-parallel over ICI)
+_register(
+    Config(
+        name="vid2vid_temporal",
+        model=ModelConfig(generator="unet", ngf=64, norm="instance",
+                          use_compression_net=False, n_frames=8),
+        loss=LossConfig(lambda_feat=10.0, lambda_vgg=0.0, lambda_tv=0.0),
+        data=DataConfig(dataset="vid2vid", image_size=256, batch_size=1,
+                        n_frames=8),
+        parallel=ParallelConfig(mesh=MeshSpec(data=-1, time=4)),
+    )
+)
+
+
+def get_preset(name: str) -> Config:
+    try:
+        return _PRESETS[name]
+    except KeyError:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(_PRESETS)}") from None
+
+
+def list_presets():
+    return sorted(_PRESETS)

@@ -1,0 +1,116 @@
+"""Device mesh construction — the substrate for every parallelism strategy.
+
+The framework uses one global ``jax.sharding.Mesh`` with up to three named
+axes:
+
+- ``data``    data parallelism (per-device batch shards, gradient psum)
+- ``spatial`` GSPMD spatial sharding of the image H dimension (large images;
+              conv halo exchange handled in ``p2p_tpu.parallel.spatial``)
+- ``time``    temporal sequence parallelism for video discriminators
+
+The reference has no distributed layer at all (SURVEY.md §2.3): its only
+parallelism is DataLoader worker processes. Here the mesh is first-class and
+every train step is jitted over it; XLA inserts the ICI collectives.
+
+On a real multi-host slice call :func:`distributed_init` first (wraps
+``jax.distributed.initialize``); on a single host (or the CPU test fixture
+with ``--xla_force_host_platform_device_count=8``) meshes are built from the
+locally visible devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+TIME_AXIS = "time"
+ALL_AXES = (DATA_AXIS, SPATIAL_AXIS, TIME_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. -1 on the data axis means "all remaining devices"."""
+
+    data: int = -1
+    spatial: int = 1
+    time: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        d, s, t = self.data, self.spatial, self.time
+        fixed = s * t
+        if d == -1:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by spatial*time={fixed}"
+                )
+            d = n_devices // fixed
+        if d * s * t != n_devices:
+            raise ValueError(
+                f"mesh {d}x{s}x{t} != {n_devices} devices"
+            )
+        return d, s, t
+
+
+def make_mesh(
+    spec: MeshSpec = MeshSpec(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global mesh.
+
+    Axis order is (data, spatial, time) with data outermost: JAX lays devices
+    out so the *innermost* axes are nearest-neighbor on the ICI torus, which
+    is where the bandwidth-hungry halo exchanges (spatial) and ring shifts
+    (time) live; data-parallel all-reduces tolerate the longer hops.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    d, s, t = spec.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(d, s, t)
+    return Mesh(dev_array, axis_names=(DATA_AXIS, SPATIAL_AXIS, TIME_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host barrier/init. No-op when running single-process."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Canonical sharding for NHWC image batches: N over data, H over spatial."""
+    return NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS, None, None))
+
+
+def video_sharding(mesh: Mesh) -> NamedSharding:
+    """NTHWC video batches: N over data, T over time, H over spatial."""
+    return NamedSharding(mesh, P(DATA_AXIS, TIME_AXIS, SPATIAL_AXIS, None, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    """Per-host batch for the input pipeline (global / number of processes)."""
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    del mesh
+    return global_batch // n_proc

@@ -1,0 +1,36 @@
+"""Functional RNG threading.
+
+The reference seeds ``torch.manual_seed`` once and relies on global stateful
+RNG (train.py:166-168). Under jit everything must be explicit, so training
+code carries a single key and derives per-step, per-purpose subkeys by
+folding in the step counter — reproducible regardless of how many steps are
+fused, resumed, or re-ordered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class RngStream:
+    """A named, step-indexed PRNG stream derived from one base key."""
+
+    base: jax.Array
+
+    @classmethod
+    def from_seed(cls, seed: int) -> "RngStream":
+        return cls(jax.random.key(seed))
+
+    def at_step(self, step) -> "RngStream":
+        return RngStream(jax.random.fold_in(self.base, step))
+
+    def key(self, name: str) -> jax.Array:
+        # Stable hash: fold in a deterministic int derived from the name.
+        h = int.from_bytes(name.encode()[:4].ljust(4, b"\0"), "little")
+        return jax.random.fold_in(self.base, h)
+
+    def split(self, n: int = 2):
+        return jax.random.split(self.base, n)

@@ -1,0 +1,27 @@
+"""Test fixture: force an 8-device CPU mesh so every sharding / collective /
+halo-exchange path is CI-able without TPU hardware (SURVEY.md §4.3)."""
+
+import os
+
+# Force-override: the session env pins JAX_PLATFORMS to the TPU tunnel, and a
+# sitecustomize hook imports jax at interpreter start — so mutate both the env
+# (for the not-yet-created CPU backend) and the live jax config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs[:8]

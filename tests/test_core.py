@@ -1,0 +1,61 @@
+import dataclasses
+
+import jax
+import pytest
+
+from p2p_tpu.core import MeshSpec, get_preset, list_presets, make_mesh
+from p2p_tpu.core.mesh import batch_sharding, video_sharding
+from p2p_tpu.core.rng import RngStream
+
+
+def test_mesh_shapes(devices8):
+    mesh = make_mesh(MeshSpec(data=-1, spatial=2), devices=devices8)
+    assert mesh.shape == {"data": 4, "spatial": 2, "time": 1}
+    mesh = make_mesh(MeshSpec(data=2, spatial=2, time=2), devices=devices8)
+    assert mesh.shape == {"data": 2, "spatial": 2, "time": 2}
+
+
+def test_mesh_bad_shape(devices8):
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec(data=3, spatial=2), devices=devices8)
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec(data=-1, spatial=3), devices=devices8)
+
+
+def test_shardings_build(devices8):
+    mesh = make_mesh(MeshSpec(data=2, spatial=2, time=2), devices=devices8)
+    import jax.numpy as jnp
+
+    x = jnp.zeros((4, 8, 8, 3))
+    xs = jax.device_put(x, batch_sharding(mesh))
+    assert xs.sharding.is_equivalent_to(batch_sharding(mesh), ndim=4)
+    v = jnp.zeros((2, 8, 8, 8, 3))
+    vs = jax.device_put(v, video_sharding(mesh))
+    assert vs.shape == v.shape
+
+
+def test_presets_complete():
+    names = list_presets()
+    # The five BASELINE.json configs plus the reference-faithful config.
+    for required in ("facades", "edges2shoes_dp", "cityscapes_spatial",
+                     "pix2pixhd", "vid2vid_temporal", "reference"):
+        assert required in names
+    cfg = get_preset("pix2pixhd")
+    assert cfg.image_hw == (512, 1024)
+    assert cfg.parallel.mesh.spatial == 2
+    cfg2 = cfg.replace(name="x")
+    assert cfg2.name == "x" and cfg.name == "pix2pixhd"
+    assert dataclasses.is_dataclass(cfg)
+
+
+def test_rng_stream_deterministic():
+    s = RngStream.from_seed(0)
+    k1 = s.at_step(3).key("dropout")
+    k2 = s.at_step(3).key("dropout")
+    k3 = s.at_step(4).key("dropout")
+    k4 = s.at_step(3).key("noise")
+    import numpy as np
+
+    assert np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+    assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k3))
+    assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k4))
