@@ -1,0 +1,25 @@
+from p2p_tpu.ops.quantize import quantize, quantize_ste
+from p2p_tpu.ops.pixel_shuffle import pixel_shuffle, pixel_unshuffle
+from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, reflect_pad_2d
+from p2p_tpu.ops.norm import BatchNorm, InstanceNorm, make_norm
+from p2p_tpu.ops.spectral_norm import SpectralConv, spectral_normalize
+from p2p_tpu.ops.tv import total_variation_loss
+from p2p_tpu.ops.sobel import sobel_edges, angular_loss
+
+__all__ = [
+    "quantize",
+    "quantize_ste",
+    "pixel_shuffle",
+    "pixel_unshuffle",
+    "ConvLayer",
+    "UpsampleConvLayer",
+    "reflect_pad_2d",
+    "BatchNorm",
+    "InstanceNorm",
+    "make_norm",
+    "SpectralConv",
+    "spectral_normalize",
+    "total_variation_loss",
+    "sobel_edges",
+    "angular_loss",
+]

@@ -1,0 +1,95 @@
+"""Convolution layers (NHWC, MXU-friendly).
+
+Reference layer library (networks.py:395-423):
+- ``ConvLayer``: ReflectionPad2d(k//2) + Conv2d, no norm/activation.
+- ``UpsampleConvLayer``: optional nearest Upsample(×s) + ReflectionPad + Conv.
+
+TPU-first notes: NHWC keeps channels on the 128-wide lane dimension; the
+reflect pad is a cheap gather XLA fuses into the conv's input; upsampling is
+nearest-neighbor (a broadcast-reshape, fusable) rather than transposed conv —
+same choice the reference made to avoid checkerboard artifacts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def reflect_pad_2d(x: jax.Array, pad: int) -> jax.Array:
+    """Reflection-pad H and W of an NHWC tensor."""
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect")
+
+
+def normal_init(stddev: float = 0.02):
+    """Reference default weight init: N(0, 0.02) (networks.py:131)."""
+    return nn.initializers.normal(stddev=stddev)
+
+
+class ConvLayer(nn.Module):
+    """ReflectionPad(k//2) + conv. Ref: networks.py:395-405."""
+
+    features: int
+    kernel_size: int
+    stride: int = 1
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+
+    @nn.compact
+    def __call__(self, x):
+        pad = self.kernel_size // 2
+        x = reflect_pad_2d(x, pad)
+        return nn.Conv(
+            features=self.features,
+            kernel_size=(self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            padding="VALID",
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            kernel_init=self.kernel_init,
+        )(x)
+
+
+def upsample_nearest(x: jax.Array, factor: int) -> jax.Array:
+    """Nearest-neighbor ×factor upsample in NHWC via broadcast-reshape."""
+    if factor == 1:
+        return x
+    n, h, w, c = x.shape
+    x = x[:, :, None, :, None, :]
+    x = jnp.broadcast_to(x, (n, h, factor, w, factor, c))
+    return x.reshape(n, h * factor, w * factor, c)
+
+
+class UpsampleConvLayer(nn.Module):
+    """Optional nearest ×upsample → ReflectionPad → conv.
+    Ref: networks.py:408-423."""
+
+    features: int
+    kernel_size: int
+    stride: int = 1
+    upsample: int = 0
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+
+    @nn.compact
+    def __call__(self, x):
+        if self.upsample:
+            x = upsample_nearest(x, self.upsample)
+        pad = self.kernel_size // 2
+        x = reflect_pad_2d(x, pad)
+        return nn.Conv(
+            features=self.features,
+            kernel_size=(self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            padding="VALID",
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            kernel_init=self.kernel_init,
+        )(x)

@@ -1,0 +1,110 @@
+"""Normalization layers.
+
+The reference uses ``BatchNorm2d`` throughout its live model zoo
+(networks.py:433 and others — the InstanceNorm ``get_norm_layer`` at
+networks.py:93-102 is dead code), trained at batch size 1, which makes its
+"batch" statistics effectively instance statistics with running-stat drift.
+The build keeps BatchNorm as the reference-faithful default, and offers
+InstanceNorm (pix2pixHD-style) plus a Pallas-fused InstanceNorm for the
+1024×512 config.
+
+Statistics are computed in fp32 regardless of the bf16 compute dtype.
+
+Cross-device sync under data parallelism: all layers here compute statistics
+with plain ``jnp`` reductions over a *logically global* batch — under
+jit+GSPMD the mesh makes those reductions global automatically (XLA inserts
+the psum over the ``data`` axis), which IS sync-BN. Under ``shard_map``
+regions pass ``axis_name='data'`` to opt in explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _gamma_init(key, shape, dtype=jnp.float32):
+    # Reference BatchNorm affine init: γ ~ N(1, 0.02) (networks.py:144-146).
+    return 1.0 + jax.random.normal(key, shape, dtype) * 0.02
+
+
+class BatchNorm(nn.Module):
+    """BatchNorm over (N,H,W) in NHWC with running stats in 'batch_stats'.
+
+    Affine init matches the reference: γ ~ N(1, 0.02), β = 0
+    (networks.py:144-146).
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9  # flax convention; equals torch momentum=0.1
+    epsilon: float = 1e-5
+    axis_name: Optional[str] = None
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        ura = (
+            self.use_running_average
+            if use_running_average is None
+            else use_running_average
+        )
+        return nn.BatchNorm(
+            use_running_average=ura,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            axis_name=self.axis_name,
+            dtype=self.dtype,
+            scale_init=_gamma_init,
+            bias_init=nn.initializers.zeros,
+            use_fast_variance=False,
+        )(x)
+
+
+class InstanceNorm(nn.Module):
+    """Per-sample, per-channel normalization over H,W (NHWC).
+
+    Matches torch ``InstanceNorm2d(affine=affine)`` semantics: statistics are
+    always per-forward (no running stats), eps inside the sqrt.
+    """
+
+    affine: bool = False
+    epsilon: float = 1e-5
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        orig_dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+        var = jnp.var(x32, axis=(1, 2), keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
+        if self.affine:
+            c = x.shape[-1]
+            scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+            y = y * scale + bias
+        return y.astype(self.dtype or orig_dtype)
+
+
+def make_norm(kind: str, *, train: bool = True, axis_name: Optional[str] = None,
+              dtype=None):
+    """Factory mapping config ``norm`` strings to layer constructors.
+
+    Returned callables construct a fresh module (use inside @nn.compact).
+    """
+    if kind == "batch":
+        return lambda: BatchNorm(
+            use_running_average=not train, axis_name=axis_name, dtype=dtype
+        )
+    if kind == "instance":
+        return lambda: InstanceNorm(dtype=dtype)
+    if kind == "pallas_instance":
+        from p2p_tpu.ops.pallas.instance_norm import PallasInstanceNorm
+
+        return lambda: PallasInstanceNorm(dtype=dtype)
+    if kind == "none":
+        return lambda: (lambda x: x)
+    raise ValueError(f"unknown norm kind {kind!r}")

@@ -1,0 +1,43 @@
+"""Pixel shuffle / unshuffle (depth↔space) in NHWC.
+
+Reference: ``PixelUnshuffle`` at networks.py:173-200 builds a one-hot conv
+kernel and runs a strided grouped conv to do space-to-depth; ``PixelShuffle``
+is torch's builtin used inside CompressionNetwork (networks.py:219).
+
+On TPU a conv is the wrong tool for a pure data-movement op — a
+reshape+transpose lowers to an XLA transpose the compiler can fuse or even
+elide into neighboring layouts. Channel ordering matches torch's
+``F.pixel_shuffle``/``F.pixel_unshuffle`` (for weight-porting parity):
+unshuffle output channel index is ``c * r^2 + dy * r + dx``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pixel_unshuffle(x: jax.Array, factor: int) -> jax.Array:
+    """NHWC space-to-depth: (N,H,W,C) -> (N,H/r,W/r,C*r²)."""
+    n, h, w, c = x.shape
+    r = factor
+    if h % r or w % r:
+        raise ValueError(f"spatial dims {(h, w)} not divisible by {r}")
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    # -> (N, H/r, W/r, c, dy, dx): flattening the last three axes yields
+    # channel index c*r² + dy*r + dx, torch's ordering.
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+def pixel_shuffle(x: jax.Array, factor: int) -> jax.Array:
+    """NHWC depth-to-space: (N,H,W,C*r²) -> (N,H*r,W*r,C). Inverse of
+    :func:`pixel_unshuffle` with torch channel ordering."""
+    n, h, w, crr = x.shape
+    r = factor
+    if crr % (r * r):
+        raise ValueError(f"channels {crr} not divisible by {r * r}")
+    c = crr // (r * r)
+    x = x.reshape(n, h, w, c, r, r)
+    x = x.transpose(0, 1, 4, 2, 5, 3)  # (N, H, dy, W, dx, C)
+    return x.reshape(n, h * r, w * r, c)

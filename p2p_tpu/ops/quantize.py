@@ -1,0 +1,62 @@
+"""Bit-depth quantizer — the core "compression" op of the pipeline.
+
+Reference: ``compress(tensor, bit)`` at generate_dataset.py:29-34 —
+``round(clamp(x, 0, 1) * (2^b - 1)) / (2^b - 1)``. It is used offline to
+build the ``b/`` dataset halves and *inside* the train loop (train.py:297).
+
+The reference version is non-differentiable (``round`` has zero gradient,
+SURVEY Q2), which silently kills learning of the compression pre-filter.
+Here the quantizer comes in two flavors:
+
+- :func:`quantize` — exact reference semantics, zero gradient through round.
+- :func:`quantize_ste` — straight-through estimator ``custom_vjp``: forward
+  identical, backward passes gradients through unchanged *inside* the clamp
+  range and zeroes them outside (the clamp's true gradient). This is the
+  intended behavior and the default (ModelConfig.quant_ste).
+
+Both are pure elementwise jnp — XLA fuses them into whatever producer or
+consumer op is adjacent; no Pallas needed (memory-bound, zero FLOPs).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _levels(bits: int) -> float:
+    return float(2**bits - 1)
+
+
+def quantize(x: jax.Array, bits: int = 3) -> jax.Array:
+    """Reference-exact quantizer: clamp to [0,1], round to 2^bits-1 levels."""
+    n = _levels(bits)
+    return jnp.round(jnp.clip(x, 0.0, 1.0) * n) / n
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def quantize_ste(x: jax.Array, bits: int = 3) -> jax.Array:
+    """Quantizer with a straight-through gradient estimator."""
+    return quantize(x, bits)
+
+
+def _ste_fwd(x, bits):
+    return quantize(x, bits), x
+
+
+def _ste_bwd(bits, x, g):
+    # Straight-through inside the clamp's active range, zero outside —
+    # matches d/dx clip(x,0,1) while treating round as identity.
+    del bits
+    mask = jnp.logical_and(x >= 0.0, x <= 1.0)
+    return (jnp.where(mask, g, jnp.zeros_like(g)),)
+
+
+quantize_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def dequantize_levels(x: jax.Array, bits: int = 3) -> jax.Array:
+    """Map quantized [0,1] values to integer level indices (inverse helper)."""
+    return jnp.round(x * _levels(bits)).astype(jnp.int32)

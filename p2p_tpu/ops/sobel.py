@@ -1,0 +1,48 @@
+"""Sobel edge magnitude + angular loss (completeness parity).
+
+Both are *dead code* in the reference (call sites commented out —
+SURVEY §2.1 #29/#30) but part of its capability surface:
+
+- ``sobelLayer`` (networks.py:852-868): fixed Sobel filters on the first
+  channel of a single image, zero padding, magnitude sqrt(Gx²+Gy²).
+- ``angular_loss`` (networks.py:870-894): mean angular error in degrees via
+  clamped cosine similarity over the channel axis.
+
+The TPU version vectorizes over the batch instead of squeezing it away and
+has no device hardcoding (the reference is CUDA-only here, SURVEY Q6).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_SOBEL_X = jnp.array([[1, 0, -1], [2, 0, -2], [1, 0, -1]], jnp.float32)
+_SOBEL_Y = jnp.array([[1, 2, 1], [0, 0, 0], [-1, -2, -1]], jnp.float32)
+
+
+def sobel_edges(img: jax.Array) -> jax.Array:
+    """Edge magnitude of channel 0. img: NHWC -> (N, H, W, 1)."""
+    x = img[..., :1].astype(jnp.float32)
+    kx = _SOBEL_X[:, :, None, None]
+    ky = _SOBEL_Y[:, :, None, None]
+    dn = ("NHWC", "HWIO", "NHWC")
+    gx = jax.lax.conv_general_dilated(x, kx, (1, 1), "SAME", dimension_numbers=dn)
+    gy = jax.lax.conv_general_dilated(x, ky, (1, 1), "SAME", dimension_numbers=dn)
+    return jnp.sqrt(gx**2 + gy**2)
+
+
+def angular_loss(illum_gt: jax.Array, illum_pred: jax.Array) -> jax.Array:
+    """Mean angular error (degrees) between per-pixel channel vectors.
+
+    Cosine similarity over the channel axis (last in NHWC; the reference's
+    dim=1 in NCHW), clamped to ±0.99999 before acos as the reference does.
+    """
+    a = illum_gt.astype(jnp.float32)
+    b = illum_pred.astype(jnp.float32)
+    dot = jnp.sum(a * b, axis=-1)
+    na = jnp.linalg.norm(a, axis=-1)
+    nb = jnp.linalg.norm(b, axis=-1)
+    cos = dot / jnp.maximum(na * nb, 1e-8)
+    cos = jnp.clip(cos, -0.99999, 0.99999)
+    return jnp.mean(jnp.arccos(cos)) * 180.0 / jnp.pi

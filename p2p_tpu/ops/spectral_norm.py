@@ -1,0 +1,109 @@
+"""Spectral normalization as a pure-functional transform.
+
+Reference: hand-rolled ``SpectralNorm`` wrapper at networks.py:525-582 —
+one power-iteration step per forward over the weight matrix viewed as
+(out_channels, -1), with persistent ``u``/``v`` vectors, applied to the two
+inner convs of every PatchGAN discriminator (networks.py:767-775).
+
+This is the reference's main stateful-op functionalization hazard
+(SURVEY §2.2): under jit there is no hidden buffer mutation, so ``u``/``v``
+live in a flax variable collection named ``'spectral'`` that the train step
+threads explicitly (mutable during training, frozen at eval). Semantics:
+
+- exactly ONE power-iteration update per *call* while ``'spectral'`` is
+  mutable — the reference updates on all three D forwards per step; we pin
+  the canonical count to the number of D calls in the step, matching it.
+- ``u``/``v`` are stop-gradiented; σ = uᵀWv keeps gradient flow through W
+  (torch.nn.utils.spectral_norm semantics, and what the reference's
+  autograd graph effectively does).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.ops.conv import normal_init
+
+
+def _l2norm(x, eps=1e-12):
+    return x / (jnp.linalg.norm(x) + eps)
+
+
+def spectral_normalize(
+    w_mat: jax.Array, u: jax.Array, n_iter: int = 1
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One (or more) power-iteration steps on matrix ``w_mat`` (rows, cols).
+
+    Returns (sigma, new_u, new_v). ``u`` is the left singular-vector
+    estimate of length ``rows``.
+    """
+    wm = jax.lax.stop_gradient(w_mat)
+    v = None
+    for _ in range(n_iter):
+        v = _l2norm(wm.T @ u)
+        u = _l2norm(wm @ v)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ w_mat @ v
+    return sigma, u, v
+
+
+class SpectralConv(nn.Module):
+    """Conv2d (NHWC, explicit zero padding) with spectral weight norm.
+
+    Power-iteration state lives in the 'spectral' collection; pass
+    ``mutable=['spectral']`` (the train step does) to advance it.
+    """
+
+    features: int
+    kernel_size: int
+    stride: int = 1
+    padding: int = 0
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+    n_power_iterations: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", self.kernel_init, (k, k, cin, self.features), jnp.float32
+        )
+        # Matrix view (out_features, k*k*cin) — rows = output channels,
+        # mirroring torch's w.view(out, -1).
+        w_mat = kernel.transpose(3, 0, 1, 2).reshape(self.features, -1)
+
+        u_var = self.variable(
+            "spectral",
+            "u",
+            lambda: _l2norm(jax.random.normal(self.make_rng("params"), (self.features,))),
+        )
+        sigma, new_u, _ = spectral_normalize(
+            w_mat, u_var.value, self.n_power_iterations
+        )
+        if self.is_mutable_collection("spectral"):
+            u_var.value = new_u
+        kernel_sn = (kernel / sigma).astype(self.dtype or x.dtype)
+
+        pad = self.padding
+        if pad:
+            x = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        y = jax.lax.conv_general_dilated(
+            x.astype(kernel_sn.dtype),
+            kernel_sn,
+            window_strides=(self.stride, self.stride),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias.astype(y.dtype)
+        return y

@@ -1,0 +1,15 @@
+"""Total-variation loss. Ref: calc_tv_Loss at train.py:123-126 —
+mean |∂x along W| + mean |∂x along H| (anisotropic, L1, mean-reduced)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def total_variation_loss(x: jax.Array) -> jax.Array:
+    """Anisotropic TV on NHWC images, fp32 reduction."""
+    x = x.astype(jnp.float32)
+    dw = jnp.mean(jnp.abs(x[:, :, :-1, :] - x[:, :, 1:, :]))
+    dh = jnp.mean(jnp.abs(x[:, :-1, :, :] - x[:, 1:, :, :]))
+    return dw + dh

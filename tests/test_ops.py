@@ -1,0 +1,218 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_tpu.ops import (
+    angular_loss,
+    pixel_shuffle,
+    pixel_unshuffle,
+    quantize,
+    quantize_ste,
+    reflect_pad_2d,
+    sobel_edges,
+    spectral_normalize,
+    total_variation_loss,
+)
+from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, upsample_nearest
+from p2p_tpu.ops.norm import BatchNorm, InstanceNorm
+from p2p_tpu.ops.spectral_norm import SpectralConv
+
+
+def rng(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------- quantizer
+def test_quantize_matches_reference_formula():
+    x = jnp.asarray(rng(2, 4, 4, 3)) * 2.0
+    for bits in (1, 3, 8):
+        n = 2**bits - 1
+        expected = np.round(np.clip(np.asarray(x), 0, 1) * n) / n
+        np.testing.assert_allclose(quantize(x, bits), expected, rtol=1e-6)
+        np.testing.assert_allclose(quantize_ste(x, bits), expected, rtol=1e-6)
+
+
+def test_quantize_grad_zero_but_ste_passes_through():
+    x = jnp.asarray([0.3, 0.7, -0.5, 1.5])
+    g_plain = jax.grad(lambda v: jnp.sum(quantize(v, 3)))(x)
+    np.testing.assert_allclose(g_plain, np.zeros(4))  # SURVEY Q2 semantics
+    g_ste = jax.grad(lambda v: jnp.sum(quantize_ste(v, 3)))(x)
+    np.testing.assert_allclose(g_ste, [1.0, 1.0, 0.0, 0.0])  # clamp mask
+
+
+# ----------------------------------------------------- pixel shuffle family
+def test_pixel_unshuffle_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = rng(2, 8, 8, 6)
+    ours = pixel_unshuffle(jnp.asarray(x), 2)
+    ref = torch.nn.functional.pixel_unshuffle(
+        torch.from_numpy(x).permute(0, 3, 1, 2), 2
+    ).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+
+def test_pixel_shuffle_matches_torch_and_roundtrip():
+    torch = pytest.importorskip("torch")
+    x = rng(2, 4, 4, 12)
+    ours = pixel_shuffle(jnp.asarray(x), 2)
+    ref = torch.nn.functional.pixel_shuffle(
+        torch.from_numpy(x).permute(0, 3, 1, 2), 2
+    ).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+    rt = pixel_unshuffle(pixel_shuffle(jnp.asarray(x), 2), 2)
+    np.testing.assert_allclose(rt, x, rtol=1e-6)
+
+
+# ------------------------------------------------------------------- convs
+def test_reflect_pad_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = rng(1, 5, 5, 2)
+    ours = reflect_pad_2d(jnp.asarray(x), 2)
+    ref = torch.nn.functional.pad(
+        torch.from_numpy(x).permute(0, 3, 1, 2), (2, 2, 2, 2), mode="reflect"
+    ).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+
+def test_conv_layer_shapes():
+    x = jnp.asarray(rng(2, 16, 16, 3))
+    layer = ConvLayer(features=8, kernel_size=9, stride=1)
+    params = layer.init(jax.random.key(0), x)
+    y = layer.apply(params, x)
+    assert y.shape == (2, 16, 16, 8)  # reflection pad keeps spatial size
+    layer = ConvLayer(features=8, kernel_size=3, stride=2)
+    y = layer.apply(layer.init(jax.random.key(0), x), x)
+    assert y.shape == (2, 8, 8, 8)
+
+
+def test_upsample_nearest_matches_numpy():
+    x = rng(1, 3, 3, 2)
+    ours = upsample_nearest(jnp.asarray(x), 2)
+    ref = np.repeat(np.repeat(x, 2, axis=1), 2, axis=2)
+    np.testing.assert_allclose(ours, ref)
+
+
+def test_upsample_conv_layer():
+    x = jnp.asarray(rng(2, 8, 8, 4))
+    layer = UpsampleConvLayer(features=2, kernel_size=3, upsample=2)
+    y = layer.apply(layer.init(jax.random.key(0), x), x)
+    assert y.shape == (2, 16, 16, 2)
+
+
+# ------------------------------------------------------------------- norms
+def test_instance_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = rng(2, 6, 5, 3)
+    ours = InstanceNorm().apply({}, jnp.asarray(x))
+    ref = torch.nn.functional.instance_norm(
+        torch.from_numpy(x).permute(0, 3, 1, 2)
+    ).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = rng(4, 6, 5, 3)
+    bn = BatchNorm(use_running_average=False)
+    variables = bn.init(jax.random.key(0), jnp.asarray(x))
+    # identity affine for comparison
+    variables = {
+        "params": {"BatchNorm_0": {"scale": jnp.ones(3), "bias": jnp.zeros(3)}},
+        "batch_stats": variables["batch_stats"],
+    }
+    ours, updated = bn.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+    tbn = torch.nn.BatchNorm2d(3, momentum=0.1)
+    tbn.train()
+    with torch.no_grad():
+        tbn.weight.fill_(1.0)
+        tbn.bias.fill_(0.0)
+        ref = tbn(torch.from_numpy(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    # running stats updated toward batch stats with flax momentum 0.9
+    rm = updated["batch_stats"]["BatchNorm_0"]["mean"]
+    np.testing.assert_allclose(rm, np.asarray(tbn.running_mean), rtol=1e-4, atol=1e-5)
+
+
+# ----------------------------------------------------------- spectral norm
+def test_spectral_normalize_converges_to_top_singular_value():
+    w = jnp.asarray(rng(8, 20))
+    u = jnp.ones(8) / np.sqrt(8)
+    for _ in range(50):
+        sigma, u, v = spectral_normalize(w, u)
+    true_sigma = np.linalg.svd(np.asarray(w), compute_uv=False)[0]
+    np.testing.assert_allclose(float(sigma), true_sigma, rtol=1e-4)
+
+
+def test_spectral_conv_updates_state_and_normalizes():
+    x = jnp.asarray(rng(1, 8, 8, 4))
+    layer = SpectralConv(features=8, kernel_size=4, stride=2, padding=1)
+    variables = layer.init(jax.random.key(0), x)
+    assert "spectral" in variables
+    y, mutated = layer.apply(variables, x, mutable=["spectral"])
+    assert y.shape == (1, 4, 4, 8)
+    u0 = variables["spectral"]["u"]
+    u1 = mutated["spectral"]["u"]
+    assert not np.allclose(u0, u1)
+    # after many applications sigma(W/sigma) -> 1
+    vars_i = {"params": variables["params"], "spectral": variables["spectral"]}
+    for _ in range(30):
+        _, m = layer.apply(vars_i, x, mutable=["spectral"])
+        vars_i = {"params": variables["params"], "spectral": m["spectral"]}
+    k = variables["params"]["kernel"]
+    w_mat = np.asarray(k).transpose(3, 0, 1, 2).reshape(8, -1)
+    u = np.asarray(vars_i["spectral"]["u"])
+    v = w_mat.T @ u
+    v /= np.linalg.norm(v) + 1e-12
+    sigma = u @ w_mat @ v
+    np.testing.assert_allclose(
+        sigma, np.linalg.svd(w_mat, compute_uv=False)[0], rtol=1e-3
+    )
+
+
+# ------------------------------------------------------------------ losses
+def test_tv_loss_matches_reference_formula():
+    x = rng(2, 5, 6, 3)
+    # reference operates NCHW; formula is layout-symmetric (train.py:123-126)
+    nchw = np.transpose(x, (0, 3, 1, 2))
+    expected = np.mean(np.abs(nchw[:, :, :, :-1] - nchw[:, :, :, 1:])) + np.mean(
+        np.abs(nchw[:, :, :-1, :] - nchw[:, :, 1:, :])
+    )
+    np.testing.assert_allclose(
+        float(total_variation_loss(jnp.asarray(x))), expected, rtol=1e-5
+    )
+
+
+def test_sobel_shapes_and_known_edge():
+    img = np.zeros((1, 8, 8, 3), np.float32)
+    img[:, :, 4:, 0] = 1.0  # vertical step edge
+    g = sobel_edges(jnp.asarray(img))
+    assert g.shape == (1, 8, 8, 1)
+    assert float(jnp.max(g[:, 1:-1, 1:-1])) == pytest.approx(4.0)
+    col = np.asarray(g[0, 2:6, :, 0])
+    assert col[:, 3].min() > 0  # edge detected at the step
+    assert np.allclose(col[:, 1], 0)  # flat region
+
+
+def test_angular_loss_zero_for_identical_and_90deg():
+    a = jnp.asarray(rng(2, 4, 4, 3)) ** 2 + 0.1
+    loss_same = float(angular_loss(a, a * 2.0))  # scale-invariant
+    assert loss_same < 0.3  # acos clamp keeps it near zero, not exactly 0
+    x = jnp.zeros((1, 1, 1, 3)).at[..., 0].set(1.0)
+    y = jnp.zeros((1, 1, 1, 3)).at[..., 1].set(1.0)
+    assert float(angular_loss(x, y)) == pytest.approx(90.0, abs=0.1)
+
+
+# ---------------------------------------------------------- pallas kernels
+def test_pallas_instance_norm_interpret_matches_xla():
+    from p2p_tpu.ops.pallas.instance_norm_kernel import instance_norm_fused
+
+    x = jnp.asarray(rng(2, 8, 8, 4))
+    scale = jnp.asarray(rng(4, seed=1))
+    bias = jnp.asarray(rng(4, seed=2))
+    got = instance_norm_fused(x, scale, bias, interpret=True)
+    mean = np.mean(np.asarray(x), axis=(1, 2), keepdims=True)
+    var = np.var(np.asarray(x), axis=(1, 2), keepdims=True)
+    want = (np.asarray(x) - mean) / np.sqrt(var + 1e-5)
+    want = want * np.asarray(scale) + np.asarray(bias)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
