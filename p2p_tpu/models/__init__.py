@@ -1,0 +1,17 @@
+from p2p_tpu.models.compression import CompressionNetwork
+from p2p_tpu.models.expand import ExpandNetwork, ResidualBlock
+from p2p_tpu.models.patchgan import MultiscaleDiscriminator, NLayerDiscriminator
+from p2p_tpu.models.vgg import VGG19Features
+from p2p_tpu.models.registry import define_C, define_D, define_G
+
+__all__ = [
+    "CompressionNetwork",
+    "ExpandNetwork",
+    "ResidualBlock",
+    "MultiscaleDiscriminator",
+    "NLayerDiscriminator",
+    "VGG19Features",
+    "define_C",
+    "define_D",
+    "define_G",
+]

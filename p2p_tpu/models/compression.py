@@ -1,0 +1,43 @@
+"""CompressionNetwork — learned residual pre-filter before quantization.
+
+Behavior parity with /root/reference/networks.py:201-236:
+input x → conv(3→64,k5)+PReLU → conv(64→64,k3)+BN+PReLU →
+conv(64→12,k3,s2)+PixelShuffle(2) → per-pixel L2-normalize over channels →
+x + residual.
+
+Differences by design (TPU-first): NHWC, bf16-capable, BatchNorm stats in
+fp32 threaded through the 'batch_stats' collection, pixel shuffle as a
+reshape/transpose instead of torch's builtin.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.ops.activations import PReLU
+from p2p_tpu.ops.conv import ConvLayer
+from p2p_tpu.ops.norm import BatchNorm
+from p2p_tpu.ops.pixel_shuffle import pixel_shuffle
+
+
+class CompressionNetwork(nn.Module):
+    features: int = 64
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        identity = x
+        y = ConvLayer(self.features, kernel_size=5, dtype=self.dtype)(x)
+        y = PReLU()(y)
+        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
+        y = BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        y = PReLU()(y)
+        y = ConvLayer(12, kernel_size=3, stride=2, dtype=self.dtype)(y)
+        y = pixel_shuffle(y, 2)
+        # Per-pixel L2 normalization over channels (torch F.normalize dim=1).
+        norm = jnp.maximum(jnp.linalg.norm(y, axis=-1, keepdims=True), 1e-12)
+        y = y / norm
+        return identity + y

@@ -1,0 +1,86 @@
+"""ExpandNetwork — the flagship generator (transform-net style).
+
+Behavior parity with /root/reference/networks.py:447-523:
+PixelUnshuffle(2) → nearest ×2 upsample (3ch→12ch at original spatial size)
+→ encoder [conv k9 12→32, conv k3 s2 32→64, conv k3 s2 64→128], each
+BN+PReLU → 9 residual blocks (128) → long skip + LeakyReLU(0.2) →
+decoder [up×2 conv 128→64, up×2 conv 64→32, conv k9 32→3], BN each,
+tanh output.
+
+The reference shares ONE nn.PReLU scalar across all encoder/decoder call
+sites (networks.py:452); replicated here via a single shared PReLU module.
+Residual blocks use BatchNorm (not InstanceNorm) exactly like the reference
+(networks.py:433) — a ``norm`` knob swaps in InstanceNorm / Pallas
+InstanceNorm for the HD configs.
+
+TPU-first: the residual trunk is where the FLOPs live — it stays in bf16 on
+the MXU and is optionally rematerialized (``remat``) to trade FLOPs for HBM
+when spatial extents are large.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.ops.activations import PReLU
+from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer
+from p2p_tpu.ops.norm import make_norm
+from p2p_tpu.ops.pixel_shuffle import pixel_unshuffle
+from p2p_tpu.ops.conv import upsample_nearest
+
+
+class ResidualBlock(nn.Module):
+    """conv-norm-relu-conv-norm + identity, relu after add.
+    Ref: networks.py:429-444."""
+
+    features: int
+    norm: str = "batch"
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(x)
+        y = mk()(y)
+        y = nn.relu(y)
+        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
+        y = mk()(y)
+        return nn.relu(y + x)
+
+
+class ExpandNetwork(nn.Module):
+    ngf: int = 32
+    n_blocks: int = 9
+    out_channels: int = 3
+    norm: str = "batch"
+    remat: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        act = PReLU()  # single shared learned scalar, as in the reference
+
+        y = pixel_unshuffle(x, 2)
+        y = upsample_nearest(y, 2)
+
+        y = act(mk()(ConvLayer(self.ngf, kernel_size=9, dtype=self.dtype)(y)))
+        y = act(mk()(ConvLayer(self.ngf * 2, kernel_size=3, stride=2, dtype=self.dtype)(y)))
+        y = act(mk()(ConvLayer(self.ngf * 4, kernel_size=3, stride=2, dtype=self.dtype)(y)))
+
+        block_cls = ResidualBlock
+        if self.remat:
+            block_cls = nn.remat(ResidualBlock, static_argnums=(2,))
+        residual = y
+        for _ in range(self.n_blocks):
+            y = block_cls(self.ngf * 4, norm=self.norm, dtype=self.dtype)(y, train)
+        y = nn.leaky_relu(y + residual, negative_slope=0.2)
+
+        y = act(mk()(UpsampleConvLayer(self.ngf * 2, kernel_size=3, upsample=2, dtype=self.dtype)(y)))
+        y = act(mk()(UpsampleConvLayer(self.ngf, kernel_size=3, upsample=2, dtype=self.dtype)(y)))
+        y = UpsampleConvLayer(self.out_channels, kernel_size=9, dtype=self.dtype)(y)
+        y = mk()(y)
+        return jnp.tanh(y)

@@ -1,0 +1,139 @@
+"""PatchGAN discriminators (pix2pixHD-style multiscale).
+
+Behavior parity with /root/reference/networks.py:716-806, num_D=3,
+n_layers=3, spectral norm on the inner convs, intermediate features
+returned for the feature-matching loss.
+
+A single NLayerDiscriminator with n_layers=3 has 5 stages (model0..model4):
+  0: conv(in→ndf,   k4, s2, pad2) + LeakyReLU(0.2)
+  1: SN conv(ndf→2ndf,  k4, s2, pad2) + LeakyReLU     [spectral norm]
+  2: SN conv(2ndf→4ndf, k4, s2, pad2) + LeakyReLU     [spectral norm]
+  3: SN conv(4ndf→8ndf, k4, s1, pad2) + LeakyReLU     [spectral norm]
+  4: conv(8ndf→1, k4, s1, pad2)
+(channel growth capped at 512; pad = ceil(3/2) = 2 exactly as the
+reference's ``padw``.)
+
+Multiscale: num_D independent discriminators; scale i sees the input
+downsampled i times by AvgPool(3, s2, pad1, count_include_pad=False).
+Output ordering matches the reference: result[0] is the FINEST scale
+(applied to the un-downsampled input) — networks.py:749.
+
+Each forward returns ``[[act_0..act_4] per scale]``. The 70×70-PatchGAN of
+classic pix2pix is the num_D=1, no-SN, no-interm-feat corner of this module.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.ops.conv import normal_init
+from p2p_tpu.ops.spectral_norm import SpectralConv
+
+
+def avg_pool_downsample(x: jax.Array) -> jax.Array:
+    """AvgPool2d(3, stride=2, padding=1, count_include_pad=False) in NHWC."""
+    ones = jnp.ones(x.shape[1:3] + (1,), x.dtype)[None]
+    sum_ = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)]
+    )
+    cnt = jax.lax.reduce_window(
+        ones, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)]
+    )
+    return sum_ / cnt
+
+
+class _PlainConv(nn.Module):
+    features: int
+    stride: int
+    padding: int = 2
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(
+            self.features,
+            kernel_size=(4, 4),
+            strides=(self.stride, self.stride),
+            padding=self.padding,
+            dtype=self.dtype,
+            kernel_init=normal_init(),
+        )(x)
+
+
+class NLayerDiscriminator(nn.Module):
+    ndf: int = 64
+    n_layers: int = 3
+    use_spectral_norm: bool = True
+    use_sigmoid: bool = False
+    get_interm_feat: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x) -> List[jax.Array]:
+        feats = []
+        nf = self.ndf
+        y = _PlainConv(nf, stride=2, dtype=self.dtype)(x)
+        y = nn.leaky_relu(y, negative_slope=0.2)
+        feats.append(y)
+
+        def inner(y, features, stride):
+            if self.use_spectral_norm:
+                y = SpectralConv(
+                    features, kernel_size=4, stride=stride, padding=2, dtype=self.dtype
+                )(y)
+            else:
+                y = _PlainConv(features, stride=stride, dtype=self.dtype)(y)
+            return nn.leaky_relu(y, negative_slope=0.2)
+
+        for _ in range(1, self.n_layers):
+            nf = min(nf * 2, 512)
+            y = inner(y, nf, stride=2)
+            feats.append(y)
+
+        nf = min(nf * 2, 512)
+        y = inner(y, nf, stride=1)
+        feats.append(y)
+
+        y = _PlainConv(1, stride=1, dtype=self.dtype)(y)
+        if self.use_sigmoid:
+            y = nn.sigmoid(y)
+        feats.append(y)
+
+        if self.get_interm_feat:
+            return feats
+        return [feats[-1]]
+
+
+class MultiscaleDiscriminator(nn.Module):
+    ndf: int = 64
+    n_layers: int = 3
+    num_D: int = 3
+    use_spectral_norm: bool = True
+    use_sigmoid: bool = False
+    get_interm_feat: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x) -> List[List[jax.Array]]:
+        results = []
+        current = x
+        for i in range(self.num_D):
+            # Finest-first result ordering; submodule index num_D-1-i keeps
+            # parameter naming aligned with the reference's scale{i} layout.
+            d = NLayerDiscriminator(
+                ndf=self.ndf,
+                n_layers=self.n_layers,
+                use_spectral_norm=self.use_spectral_norm,
+                use_sigmoid=self.use_sigmoid,
+                get_interm_feat=self.get_interm_feat,
+                dtype=self.dtype,
+                name=f"scale{self.num_D - 1 - i}",
+            )
+            results.append(d(current))
+            if i != self.num_D - 1:
+                current = avg_pool_downsample(current)
+        return results

@@ -1,0 +1,122 @@
+"""Model factories — the TPU-native counterpart of the reference's
+``define_C`` / ``define_G`` / ``define_D`` (networks.py:157,164,708).
+
+Factories build flax modules from :class:`p2p_tpu.core.config.ModelConfig`
+and expose :func:`init_variables`, which re-draws weights per the configured
+init type (normal/xavier/kaiming/orthogonal — networks.py:128-150 semantics:
+conv/linear kernels re-initialized, BatchNorm γ~N(1,0.02), biases zero).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.core import freeze, unfreeze
+
+from p2p_tpu.core.config import ModelConfig
+from p2p_tpu.models.compression import CompressionNetwork
+from p2p_tpu.models.expand import ExpandNetwork
+from p2p_tpu.models.patchgan import MultiscaleDiscriminator
+
+
+def define_C(cfg: ModelConfig, dtype=None) -> nn.Module:
+    return CompressionNetwork(dtype=dtype)
+
+
+def define_G(cfg: ModelConfig, dtype=None, remat: bool = False) -> nn.Module:
+    if cfg.generator == "expand":
+        return ExpandNetwork(
+            ngf=cfg.ngf,
+            n_blocks=cfg.n_blocks,
+            out_channels=cfg.output_nc,
+            norm=cfg.norm,
+            remat=remat,
+            dtype=dtype,
+        )
+    if cfg.generator == "unet":
+        from p2p_tpu.models.unet import UNetGenerator
+
+        return UNetGenerator(
+            ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm, dtype=dtype
+        )
+    if cfg.generator == "resnet":
+        from p2p_tpu.models.resnet_gen import ResnetGenerator
+
+        return ResnetGenerator(
+            ngf=cfg.ngf,
+            n_blocks=cfg.n_blocks,
+            out_channels=cfg.output_nc,
+            norm=cfg.norm,
+            remat=remat,
+            dtype=dtype,
+        )
+    if cfg.generator == "pix2pixhd":
+        from p2p_tpu.models.pix2pixhd import Pix2PixHDGenerator
+
+        return Pix2PixHDGenerator(
+            ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm,
+            remat=remat, dtype=dtype,
+        )
+    raise ValueError(f"unknown generator {cfg.generator!r}")
+
+
+def define_D(cfg: ModelConfig, dtype=None) -> nn.Module:
+    return MultiscaleDiscriminator(
+        ndf=cfg.ndf,
+        n_layers=cfg.n_layers_D,
+        num_D=cfg.num_D,
+        use_spectral_norm=cfg.use_spectral_norm,
+        get_interm_feat=cfg.get_interm_feat,
+        dtype=dtype,
+    )
+
+
+# ---------------------------------------------------------------- init types
+
+def _kernel_initializer(init_type: str, gain: float):
+    if init_type == "normal":
+        return nn.initializers.normal(stddev=gain)
+    if init_type == "xavier":
+        return nn.initializers.xavier_normal()
+    if init_type == "kaiming":
+        return nn.initializers.kaiming_normal()
+    if init_type == "orthogonal":
+        return nn.initializers.orthogonal(scale=gain)
+    raise ValueError(f"unknown init type {init_type!r}")
+
+
+def apply_init_type(
+    params: Dict[str, Any], rng: jax.Array, init_type: str = "normal",
+    gain: float = 0.02
+) -> Dict[str, Any]:
+    """Re-draw conv/linear kernels per the configured initializer.
+
+    Leaves biases, norm affines (already γ~N(1,0.02)/β=0 at init), PReLU
+    alphas and spectral-norm state untouched.
+    """
+    init_fn = _kernel_initializer(init_type, gain)
+    flat = jax.tree_util.tree_flatten_with_path(unfreeze(params))[0]
+    treedef = jax.tree_util.tree_structure(unfreeze(params))
+    leaves = []
+    for i, (path, leaf) in enumerate(flat):
+        last = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if last == "kernel" and getattr(leaf, "ndim", 0) >= 2:
+            sub = jax.random.fold_in(rng, i)
+            leaves.append(init_fn(sub, leaf.shape, leaf.dtype))
+        else:
+            leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def init_variables(module: nn.Module, rng: jax.Array, sample_input,
+                   init_type: str = "normal", gain: float = 0.02, **kwargs):
+    """init() + configured weight re-draw; returns the full variable dict."""
+    variables = unfreeze(module.init(rng, sample_input, **kwargs))
+    if init_type != "normal":  # modules already default to normal(0.02)
+        variables["params"] = apply_init_type(
+            variables["params"], jax.random.fold_in(rng, 7), init_type, gain
+        )
+    return variables

@@ -1,0 +1,101 @@
+"""VGG19 feature extractor for the perceptual loss.
+
+Behavior parity with /root/reference/networks.py:32-62: the torchvision
+VGG19 ``features`` trunk split at indices 2/7/12/21/30, returning the five
+activations after relu1_1, relu2_1, relu3_1, relu4_1, relu5_1. The
+reference feeds [-1,1] images with NO ImageNet normalization
+(networks.py:26); that choice is preserved at the loss level
+(LossConfig.vgg_imagenet_norm).
+
+Weights: this environment has no torchvision / no egress, so pretrained
+weights load from an ``.npz`` asset when available (path via
+``P2P_TPU_VGG19_NPZ`` or ``p2p_tpu/assets/vgg19.npz``); otherwise the
+extractor falls back to a FIXED-SEED random init — still a valid (random
+projection) perceptual loss for smoke tests, and flagged via
+``vgg19_params_source()`` so quality claims are made only with real weights.
+``scripts/convert_vgg19.py`` converts torchvision's state-dict when run in an
+environment that has it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# (name, out_channels); 'M' = maxpool. Standard VGG19 trunk through conv5_1.
+_CFG = [
+    ("conv1_1", 64), ("conv1_2", 64), ("M", 0),
+    ("conv2_1", 128), ("conv2_2", 128), ("M", 0),
+    ("conv3_1", 256), ("conv3_2", 256), ("conv3_3", 256), ("conv3_4", 256), ("M", 0),
+    ("conv4_1", 512), ("conv4_2", 512), ("conv4_3", 512), ("conv4_4", 512), ("M", 0),
+    ("conv5_1", 512),
+]
+# Taps after these convs' relus == torchvision indices 2/7/12/21/30.
+_TAPS = ("conv1_1", "conv2_1", "conv3_1", "conv4_1", "conv5_1")
+
+_IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+_IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class VGG19Features(nn.Module):
+    """Frozen VGG19 trunk; returns the 5 tap activations (NHWC)."""
+
+    dtype: Optional[jnp.dtype] = None
+    imagenet_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x) -> List[jax.Array]:
+        if self.imagenet_norm:
+            # incoming images are [-1,1]; map to [0,1] then standardize
+            x = (x + 1.0) * 0.5
+            x = (x - _IMAGENET_MEAN) / _IMAGENET_STD
+        outs = []
+        y = x
+        for name, ch in _CFG:
+            if name == "M":
+                y = nn.max_pool(y, (2, 2), strides=(2, 2))
+                continue
+            y = nn.Conv(
+                ch, kernel_size=(3, 3), padding=1, dtype=self.dtype, name=name
+            )(y)
+            y = nn.relu(y)
+            if name in _TAPS:
+                outs.append(y)
+        return outs
+
+
+_DEFAULT_ASSET = os.path.join(os.path.dirname(__file__), "..", "assets", "vgg19.npz")
+
+
+def vgg19_npz_path() -> Optional[str]:
+    p = os.environ.get("P2P_TPU_VGG19_NPZ", _DEFAULT_ASSET)
+    return p if os.path.exists(p) else None
+
+
+def vgg19_params_source() -> str:
+    """'pretrained' if an npz asset is present, else 'random'."""
+    return "pretrained" if vgg19_npz_path() else "random"
+
+
+def load_vgg19_params(dtype=jnp.float32):
+    """Build the frozen VGG19 param tree (pretrained npz or fixed-seed random)."""
+    path = vgg19_npz_path()
+    model = VGG19Features()
+    if path is None:
+        dummy = jnp.zeros((1, 64, 64, 3), dtype)
+        return model.init(jax.random.key(190), dummy)["params"]
+    data = np.load(path)
+    params = {}
+    for name, ch in _CFG:
+        if name == "M":
+            continue
+        kernel = jnp.asarray(data[f"{name}_kernel"], dtype)  # HWIO
+        bias = jnp.asarray(data[f"{name}_bias"], dtype)
+        assert kernel.shape[-1] == ch, (name, kernel.shape)
+        params[name] = {"kernel": kernel, "bias": bias}
+    return params

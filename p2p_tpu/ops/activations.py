@@ -1,0 +1,25 @@
+"""Activations the reference uses that flax lacks.
+
+``nn.PReLU()`` in torch carries ONE learned scalar (init 0.25) shared over
+all channels; the reference's ExpandNetwork even shares a single instance
+across every call site (networks.py:452,500-520), so the module here is
+instantiated once and reused to keep parameter-count parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class PReLU(nn.Module):
+    init: float = 0.25
+
+    @nn.compact
+    def __call__(self, x):
+        a = self.param("alpha", nn.initializers.constant(self.init), (), jnp.float32)
+        return jnp.maximum(x, 0) + a.astype(x.dtype) * jnp.minimum(x, 0)
+
+
+def leaky_relu(x, slope: float = 0.2):
+    return nn.leaky_relu(x, negative_slope=slope)

@@ -1,0 +1,41 @@
+"""One-time torchvision VGG19 → npz converter (run where torchvision exists).
+
+Produces the asset consumed by p2p_tpu.models.vgg.load_vgg19_params:
+arrays ``{conv}_kernel`` in HWIO layout and ``{conv}_bias``, for the trunk
+through conv5_1 (torchvision ``features`` indices 0..28).
+
+Usage: python scripts/convert_vgg19.py [out.npz]
+"""
+
+import sys
+
+import numpy as np
+
+# torchvision features indices of the conv layers through conv5_1
+_CONV_IDX = {
+    "conv1_1": 0, "conv1_2": 2,
+    "conv2_1": 5, "conv2_2": 7,
+    "conv3_1": 10, "conv3_2": 12, "conv3_3": 14, "conv3_4": 16,
+    "conv4_1": 19, "conv4_2": 21, "conv4_3": 23, "conv4_4": 25,
+    "conv5_1": 28,
+}
+
+
+def main(out_path: str = "p2p_tpu/assets/vgg19.npz"):
+    from torchvision.models import vgg19
+
+    feats = vgg19(weights="IMAGENET1K_V1").features
+    arrays = {}
+    for name, idx in _CONV_IDX.items():
+        conv = feats[idx]
+        # torch OIHW -> HWIO
+        arrays[f"{name}_kernel"] = (
+            conv.weight.detach().numpy().transpose(2, 3, 1, 0)
+        )
+        arrays[f"{name}_bias"] = conv.bias.detach().numpy()
+    np.savez(out_path, **arrays)
+    print(f"wrote {out_path}: {sorted(arrays)}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,0 +1,115 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_tpu.core.config import ModelConfig
+from p2p_tpu.models import (
+    CompressionNetwork,
+    ExpandNetwork,
+    MultiscaleDiscriminator,
+    NLayerDiscriminator,
+    VGG19Features,
+)
+from p2p_tpu.models.registry import define_C, define_D, define_G, init_variables
+
+
+def nparams(tree):
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_compression_network_shape_and_residual():
+    x = jnp.asarray(np.random.default_rng(0).uniform(-1, 1, (2, 32, 32, 3)), jnp.float32)
+    net = CompressionNetwork()
+    variables = net.init(jax.random.key(0), x)
+    y, _ = net.apply(variables, x, mutable=["batch_stats"])
+    assert y.shape == x.shape
+    # residual is L2-normalized per pixel → ||y-x|| per pixel == 1
+    r = np.linalg.norm(np.asarray(y - x), axis=-1)
+    np.testing.assert_allclose(r, np.ones_like(r), rtol=1e-4)
+
+
+def test_expand_network_shape_and_range():
+    x = jnp.asarray(np.random.default_rng(0).uniform(0, 1, (1, 64, 64, 3)), jnp.float32)
+    net = ExpandNetwork()
+    variables = net.init(jax.random.key(0), x)
+    y, _ = net.apply(variables, x, mutable=["batch_stats"])
+    assert y.shape == (1, 64, 64, 3)
+    assert float(jnp.max(jnp.abs(y))) <= 1.0  # tanh output
+    # Reference conv1 kernel: 12ch in, 32 out, 9x9 (networks.py:460)
+    k = variables["params"]["ConvLayer_0"]["Conv_0"]["kernel"]
+    assert k.shape == (9, 9, 12, 32)
+
+
+def test_expand_network_shares_one_prelu():
+    x = jnp.zeros((1, 32, 32, 3))
+    net = ExpandNetwork(n_blocks=2)
+    variables = net.init(jax.random.key(0), x)
+    prelu_params = [k for k in variables["params"] if k.startswith("PReLU")]
+    assert prelu_params == ["PReLU_0"]  # single shared scalar, ref networks.py:452
+
+
+def test_nlayer_discriminator_stages():
+    x = jnp.zeros((1, 64, 64, 6))
+    d = NLayerDiscriminator(ndf=64, n_layers=3)
+    variables = d.init(jax.random.key(0), x)
+    feats = d.apply(variables, x, mutable=["spectral"])[0]
+    assert len(feats) == 5  # n_layers + 2 stages, ref networks.py:789-804
+    chans = [f.shape[-1] for f in feats]
+    assert chans == [64, 128, 256, 512, 1]
+    # stride-2 stages halve (with the k4/pad2 +1 quirk: floor(H/2)+1)
+    hs = [f.shape[1] for f in feats]
+    assert hs == [33, 17, 9, 10, 11]
+    # spectral norm on exactly the 3 inner convs
+    assert len(jax.tree_util.tree_leaves(variables["spectral"])) == 3
+
+
+def test_multiscale_discriminator_orders_finest_first():
+    x = jnp.zeros((1, 64, 64, 6))
+    d = MultiscaleDiscriminator(ndf=16, num_D=3)
+    variables = d.init(jax.random.key(0), x)
+    out = d.apply(variables, x, mutable=["spectral"])[0]
+    assert len(out) == 3
+    # finest scale (full res) first, each subsequent scale halved by avgpool
+    assert out[0][0].shape[1] > out[1][0].shape[1] > out[2][0].shape[1]
+    assert {f"scale{i}" for i in range(3)} <= set(variables["params"].keys())
+
+
+def test_vgg19_taps():
+    x = jnp.zeros((1, 64, 64, 3))
+    m = VGG19Features()
+    variables = m.init(jax.random.key(0), x)
+    outs = m.apply(variables, x)
+    assert [o.shape[-1] for o in outs] == [64, 128, 256, 512, 512]
+    assert [o.shape[1] for o in outs] == [64, 32, 16, 8, 4]
+
+
+def test_registry_factories_and_init_types():
+    cfg = ModelConfig()
+    x = jnp.zeros((1, 32, 32, 3))
+    g = define_G(cfg)
+    c = define_C(cfg)
+    d = define_D(cfg)
+    vg = init_variables(g, jax.random.key(0), x)
+    vc = init_variables(c, jax.random.key(1), x)
+    vd = init_variables(d, jax.random.key(2), jnp.zeros((1, 32, 32, 6)))
+    assert nparams(vg["params"]) > 100_000
+    assert nparams(vc["params"]) > 10_000
+    assert nparams(vd["params"]) > 1_000_000  # 3 PatchGANs
+
+    v_orth = init_variables(g, jax.random.key(0), x, init_type="orthogonal", gain=1.0)
+    k = v_orth["params"]["ConvLayer_1"]["Conv_0"]["kernel"]
+    m = np.asarray(k).reshape(-1, k.shape[-1])
+    np.testing.assert_allclose(m.T @ m, np.eye(k.shape[-1]), atol=1e-4)
+
+
+def test_vgg_fallback_is_deterministic():
+    from p2p_tpu.models.vgg import load_vgg19_params, vgg19_params_source
+
+    assert vgg19_params_source() == "random"
+    p1 = load_vgg19_params()
+    p2 = load_vgg19_params()
+    l1 = jax.tree_util.tree_leaves(p1)
+    l2 = jax.tree_util.tree_leaves(p2)
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(a, b)
