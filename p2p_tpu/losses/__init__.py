@@ -1,0 +1,16 @@
+from p2p_tpu.losses.gan import gan_loss
+from p2p_tpu.losses.feature_matching import feature_matching_loss
+from p2p_tpu.losses.perceptual import VGG_SLICE_WEIGHTS, vgg_loss
+from p2p_tpu.losses.metrics import psnr, ssim
+from p2p_tpu.losses.fid import frechet_distance, gaussian_stats
+
+__all__ = [
+    "gan_loss",
+    "feature_matching_loss",
+    "vgg_loss",
+    "VGG_SLICE_WEIGHTS",
+    "psnr",
+    "ssim",
+    "frechet_distance",
+    "gaussian_stats",
+]

@@ -1,0 +1,73 @@
+"""Fréchet distance machinery for FID-style metrics.
+
+The BASELINE.json north star requires FID parity; the reference computes no
+FID at all (PSNR/SSIM only — train.py:54-65). The Fréchet computation here
+is feature-extractor-agnostic: pair it with InceptionV3 activations when
+that asset is available, or with VGG19 tap activations ("VFID") from
+:mod:`p2p_tpu.models.vgg` — the asset situation is reported by
+``p2p_tpu.models.vgg.vgg19_params_source()``.
+
+Statistics accumulate incrementally on device (sum / outer-product sums) so
+eval never materializes the full activation matrix; the final distance runs
+on host in float64 where the matrix sqrt wants the precision.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gaussian_stats(feats: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Mean and covariance of (N, D) features, fp32 on device."""
+    f = feats.astype(jnp.float32)
+    mu = jnp.mean(f, axis=0)
+    centered = f - mu
+    cov = centered.T @ centered / (f.shape[0] - 1)
+    return mu, cov
+
+
+class RunningStats:
+    """Host-side incremental accumulator for activation statistics."""
+
+    def __init__(self, dim: int):
+        self.n = 0
+        self.sum = np.zeros(dim, np.float64)
+        self.outer = np.zeros((dim, dim), np.float64)
+
+    def update(self, feats) -> None:
+        f = np.asarray(feats, np.float64)
+        self.n += f.shape[0]
+        self.sum += f.sum(axis=0)
+        self.outer += f.T @ f
+
+    def finalize(self) -> Tuple[np.ndarray, np.ndarray]:
+        mu = self.sum / self.n
+        cov = (self.outer - self.n * np.outer(mu, mu)) / (self.n - 1)
+        return mu, cov
+
+
+def frechet_distance(mu1, cov1, mu2, cov2, eps: float = 1e-6) -> float:
+    """d² = |μ1−μ2|² + tr(C1 + C2 − 2·(C1·C2)^½), via scipy-free eigendecomp."""
+    mu1 = np.asarray(mu1, np.float64)
+    mu2 = np.asarray(mu2, np.float64)
+    cov1 = np.asarray(cov1, np.float64)
+    cov2 = np.asarray(cov2, np.float64)
+    diff = mu1 - mu2
+
+    # sqrtm(C1 C2) trace via the symmetric-product trick:
+    # tr sqrt(C1 C2) = tr sqrt(S1 C2 S1) where S1 = sqrt(C1) (symmetric PSD).
+    def _sym_sqrt(m):
+        vals, vecs = np.linalg.eigh(m)
+        vals = np.clip(vals, 0, None)
+        return (vecs * np.sqrt(vals)) @ vecs.T
+
+    s1 = _sym_sqrt(cov1 + eps * np.eye(len(cov1)))
+    inner = s1 @ cov2 @ s1
+    vals = np.linalg.eigvalsh((inner + inner.T) / 2)
+    tr_sqrt = np.sqrt(np.clip(vals, 0, None)).sum()
+    d2 = diff @ diff + np.trace(cov1) + np.trace(cov2) - 2.0 * tr_sqrt
+    return float(max(d2, 0.0))  # eps regularization can leave tiny negatives

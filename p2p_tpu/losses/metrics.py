@@ -1,0 +1,76 @@
+"""Image quality metrics — in-graph PSNR/SSIM.
+
+The reference computes PSNR/SSIM per epoch on uint8-roundtripped images
+(train.py:54-65) — and does so in a DISTORTED space: its ``tensor2img``
+multiplies tanh [-1,1] outputs by 255 and clips, zeroing all negative pixels
+(SURVEY Q8), which is where its Inf-PSNR anomalies come from.
+
+This build computes metrics correctly by default — images mapped
+(x+1)/2*255 with optional uint8 quantization to match the reference's
+roundtrip — and keeps the bug-compatible scaling behind
+``ref_buggy_scale=True`` so the deviation is reproducible on demand.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def to_uint8_space(x: jax.Array, ref_buggy_scale: bool = False,
+                   quantize_uint8: bool = True) -> jax.Array:
+    """Map [-1,1] images to the [0,255] space metrics are computed in."""
+    x = x.astype(jnp.float32)
+    if ref_buggy_scale:
+        y = jnp.clip(x * 255.0, 0, 255)  # train.py:38-39 semantics
+    else:
+        y = jnp.clip((x + 1.0) * 0.5 * 255.0, 0, 255)  # utils.py:17 semantics
+    if quantize_uint8:
+        y = jnp.round(y)
+    return y
+
+
+def psnr(target: jax.Array, pred: jax.Array, ref_buggy_scale: bool = False,
+         max_db: float = 60.0) -> jax.Array:
+    """10·log10(255²/MSE), clamped to ``max_db`` (the reference clamps its
+    Inf-PSNR readings to 60.0 — train.py:480-482)."""
+    t = to_uint8_space(target, ref_buggy_scale)
+    p = to_uint8_space(pred, ref_buggy_scale)
+    mse = jnp.mean((t - p) ** 2)
+    val = 10.0 * jnp.log10(255.0**2 / jnp.maximum(mse, 1e-12))
+    return jnp.minimum(val, max_db)
+
+
+def _uniform_window(x: jax.Array, win: int) -> jax.Array:
+    """Mean filter over win×win windows, per channel (NHWC), VALID."""
+    c = x.shape[-1]
+    kernel = jnp.full((win, win, 1, 1), 1.0 / (win * win), jnp.float32)
+    kernel = jnp.tile(kernel, (1, 1, 1, c))
+    return jax.lax.conv_general_dilated(
+        x, kernel, (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+    )
+
+
+def ssim(target: jax.Array, pred: jax.Array, ref_buggy_scale: bool = False,
+         win: int = 7) -> jax.Array:
+    """Mean SSIM with a uniform win×win window, matching
+    skimage.metrics.structural_similarity defaults for uint8 inputs
+    (win=7, uniform filter, L=255, K1=0.01, K2=0.03, multichannel mean) —
+    the exact configuration the reference calls at train.py:54-58."""
+    t = to_uint8_space(target, ref_buggy_scale)
+    p = to_uint8_space(pred, ref_buggy_scale)
+    L = 255.0
+    c1, c2 = (0.01 * L) ** 2, (0.03 * L) ** 2
+    mu_t = _uniform_window(t, win)
+    mu_p = _uniform_window(p, win)
+    # skimage uses unbiased covariance (ddof=1) via cov_norm = N/(N-1)
+    n = win * win
+    cov_norm = n / (n - 1.0)
+    var_t = cov_norm * (_uniform_window(t * t, win) - mu_t**2)
+    var_p = cov_norm * (_uniform_window(p * p, win) - mu_p**2)
+    cov = cov_norm * (_uniform_window(t * p, win) - mu_t * mu_p)
+    num = (2 * mu_t * mu_p + c1) * (2 * cov + c2)
+    den = (mu_t**2 + mu_p**2 + c1) * (var_t + var_p + c2)
+    return jnp.mean(num / den)

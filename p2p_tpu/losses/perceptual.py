@@ -1,0 +1,39 @@
+"""VGG19 perceptual loss.
+
+Behavior parity with the reference ``VGGLoss`` (networks.py:18-30): L1
+between the five tap activations with weights [1/32, 1/16, 1/8, 1/4, 1],
+target features detached. The reference feeds [-1,1] images straight into
+VGG with no ImageNet normalization (networks.py:26) — kept as the default
+(``imagenet_norm=False``) since it changes the loss scale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from p2p_tpu.models.vgg import VGG19Features
+
+VGG_SLICE_WEIGHTS = (1.0 / 32, 1.0 / 16, 1.0 / 8, 1.0 / 4, 1.0)
+
+
+def vgg_loss(
+    vgg_params: Dict[str, Any],
+    x: jax.Array,
+    y: jax.Array,
+    imagenet_norm: bool = False,
+    dtype=None,
+) -> jax.Array:
+    """Perceptual distance between x and y (target y stop-gradiented)."""
+    model = VGG19Features(dtype=dtype, imagenet_norm=imagenet_norm)
+    feats_x = model.apply({"params": vgg_params}, x)
+    feats_y = model.apply({"params": vgg_params}, jax.lax.stop_gradient(y))
+    total = jnp.zeros((), jnp.float32)
+    for w, fx, fy in zip(VGG_SLICE_WEIGHTS, feats_x, feats_y):
+        fy = jax.lax.stop_gradient(fy)
+        total = total + w * jnp.mean(
+            jnp.abs(fx.astype(jnp.float32) - fy.astype(jnp.float32))
+        )
+    return total

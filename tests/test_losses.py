@@ -1,0 +1,171 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_tpu.losses import (
+    feature_matching_loss,
+    frechet_distance,
+    gan_loss,
+    gaussian_stats,
+    psnr,
+    ssim,
+    vgg_loss,
+)
+from p2p_tpu.losses.fid import RunningStats
+
+
+def rng(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+
+
+# ------------------------------------------------------------------ GANLoss
+def test_lsgan_multiscale_sums_final_maps():
+    # three scales, each a list of "features" where only [-1] counts
+    preds = [
+        [jnp.ones((1, 4, 4, 8)), jnp.full((1, 2, 2, 1), 0.5)],
+        [jnp.zeros((1, 2, 2, 8)), jnp.full((1, 1, 1, 1), 0.25)],
+    ]
+    # vs real: mean((p-1)^2) summed over scales
+    want = (0.5 - 1) ** 2 + (0.25 - 1) ** 2
+    np.testing.assert_allclose(float(gan_loss(preds, True, "lsgan")), want, rtol=1e-6)
+    want_fake = 0.5**2 + 0.25**2
+    np.testing.assert_allclose(
+        float(gan_loss(preds, False, "lsgan")), want_fake, rtol=1e-6
+    )
+
+
+def test_vanilla_matches_bce_with_logits():
+    torch = pytest.importorskip("torch")
+    logits = rng(2, 5, 5, 1)
+    preds = [[jnp.asarray(logits)]]
+    ours = float(gan_loss(preds, True, "vanilla"))
+    ref = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.from_numpy(logits), torch.ones(2, 5, 5, 1)
+    ).item()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_hinge_modes():
+    p = [[jnp.asarray([[0.5, -2.0]])]]
+    assert float(gan_loss(p, True, "hinge", for_discriminator=True)) == pytest.approx(
+        ((1 - 0.5) + 3.0) / 2
+    )
+    assert float(gan_loss(p, False, "hinge", for_discriminator=True)) == pytest.approx(
+        (1.5 + 0.0) / 2
+    )
+    assert float(gan_loss(p, True, "hinge", for_discriminator=False)) == pytest.approx(
+        -(0.5 - 2.0) / 2
+    )
+
+
+# ------------------------------------------------------- feature matching
+def test_feature_matching_reference_weighting():
+    # num_D=3 scales, 5 feats each; only first 4 count; weight (4/4)*(1/3)*10
+    fake = [[jnp.zeros((1, 4, 4, 2))] * 5 for _ in range(3)]
+    real = [[jnp.ones((1, 4, 4, 2))] * 5 for _ in range(3)]
+    got = float(feature_matching_loss(fake, real, n_layers=3, lambda_feat=10.0))
+    want = 3 * 4 * (1 / 3) * (4 / 4) * 1.0 * 10.0  # |0-1| mean = 1 per layer
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_feature_matching_stops_gradient_to_real():
+    fake = [[jnp.zeros((1, 2, 2, 1))] * 2]
+    def f(r):
+        real = [[r] * 2]
+        return feature_matching_loss(fake, real)
+    g = jax.grad(f)(jnp.ones((1, 2, 2, 1)))
+    np.testing.assert_allclose(g, np.zeros((1, 2, 2, 1)))
+
+
+# ------------------------------------------------------------- perceptual
+def test_vgg_loss_zero_for_identical_and_positive_otherwise():
+    from p2p_tpu.models.vgg import load_vgg19_params
+
+    params = load_vgg19_params()
+    x = jnp.asarray(rng(1, 32, 32, 3))
+    assert float(vgg_loss(params, x, x)) == pytest.approx(0.0, abs=1e-5)
+    y = jnp.asarray(rng(1, 32, 32, 3, seed=1))
+    assert float(vgg_loss(params, x, y)) > 0.0
+
+
+# ---------------------------------------------------------------- metrics
+def test_psnr_known_value():
+    t = jnp.zeros((1, 8, 8, 3))
+    p = jnp.zeros((1, 8, 8, 3))
+    assert float(psnr(t, p)) == pytest.approx(60.0)  # clamp, ref train.py:480
+    # uniform error of exactly 2/255*127.5=... construct directly in uint8 space
+    t = jnp.full((1, 8, 8, 3), -1.0)
+    p = jnp.full((1, 8, 8, 3), -1.0 + 2.0 * 10 / 255)  # 10 uint8 steps apart
+    want = 10 * np.log10(255**2 / 10**2)
+    assert float(psnr(t, p)) == pytest.approx(want, abs=1e-3)
+
+
+def _ssim_numpy_oracle(a8: np.ndarray, b8: np.ndarray, win: int = 7) -> float:
+    """Independent skimage-default SSIM (uniform window, ddof=1, L=255)."""
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    vals = []
+    for c in range(a8.shape[2]):
+        aw = sliding_window_view(a8[:, :, c].astype(np.float64), (win, win))
+        bw = sliding_window_view(b8[:, :, c].astype(np.float64), (win, win))
+        aw = aw.reshape(-1, win * win)
+        bw = bw.reshape(-1, win * win)
+        mu_a, mu_b = aw.mean(1), bw.mean(1)
+        va = aw.var(1, ddof=1)
+        vb = bw.var(1, ddof=1)
+        cov = ((aw - mu_a[:, None]) * (bw - mu_b[:, None])).sum(1) / (win * win - 1)
+        c1, c2 = (0.01 * 255) ** 2, (0.03 * 255) ** 2
+        s = ((2 * mu_a * mu_b + c1) * (2 * cov + c2)) / (
+            (mu_a**2 + mu_b**2 + c1) * (va + vb + c2)
+        )
+        vals.append(s.mean())
+    return float(np.mean(vals))
+
+
+def test_ssim_matches_windowed_oracle():
+    if pytest.importorskip("importlib.util").find_spec("skimage"):
+        pass  # skimage unavailable in this image; numpy oracle below
+    a8 = np.random.default_rng(0).integers(0, 256, (32, 32, 3)).astype(np.uint8)
+    b8 = np.clip(
+        a8.astype(np.int32)
+        + np.random.default_rng(1).integers(-20, 20, a8.shape),
+        0,
+        255,
+    ).astype(np.uint8)
+    a = jnp.asarray(a8.astype(np.float32) / 127.5 - 1.0)[None]
+    b = jnp.asarray(b8.astype(np.float32) / 127.5 - 1.0)[None]
+    ours = float(ssim(a, b))
+    ref = _ssim_numpy_oracle(a8, b8)
+    np.testing.assert_allclose(ours, ref, atol=5e-3)
+    assert float(ssim(a, a)) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_buggy_scale_mode_differs():
+    t = jnp.asarray(rng(1, 8, 8, 3)) * 0.5
+    p = jnp.asarray(rng(1, 8, 8, 3, seed=5)) * 0.5
+    assert float(psnr(t, p)) != pytest.approx(float(psnr(t, p, ref_buggy_scale=True)))
+
+
+# -------------------------------------------------------------------- FID
+def test_frechet_distance_identities():
+    mu = np.zeros(4)
+    cov = np.eye(4)
+    assert frechet_distance(mu, cov, mu, cov) == pytest.approx(0.0, abs=1e-8)
+    mu2 = np.ones(4)
+    assert frechet_distance(mu, cov, mu2, cov) == pytest.approx(4.0, abs=1e-4)
+    # diagonal covariances: tr(C1+C2-2 sqrt(C1 C2))
+    cov2 = 4 * np.eye(4)
+    want = 4 * (1 + 4 - 2 * 2)
+    assert frechet_distance(mu, cov, mu, cov2) == pytest.approx(want, abs=1e-4)
+
+
+def test_running_stats_match_batch_stats():
+    x = rng(100, 6)
+    rs = RunningStats(6)
+    rs.update(x[:30])
+    rs.update(x[30:])
+    mu, cov = rs.finalize()
+    mu_j, cov_j = gaussian_stats(jnp.asarray(x))
+    np.testing.assert_allclose(mu, mu_j, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cov, cov_j, rtol=1e-3, atol=1e-4)
