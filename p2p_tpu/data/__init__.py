@@ -1,0 +1,18 @@
+from p2p_tpu.data.generate import compress_uint8, generate_dataset, generate_patches
+from p2p_tpu.data.pipeline import (
+    PairedImageDataset,
+    device_prefetch,
+    make_loader,
+)
+from p2p_tpu.data.synthetic import make_synthetic_dataset, synthetic_batch
+
+__all__ = [
+    "compress_uint8",
+    "generate_dataset",
+    "generate_patches",
+    "PairedImageDataset",
+    "make_loader",
+    "device_prefetch",
+    "make_synthetic_dataset",
+    "synthetic_batch",
+]

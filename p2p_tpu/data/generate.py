@@ -1,0 +1,111 @@
+"""Offline paired-dataset generation.
+
+Capability parity with /root/reference/generate_dataset.py: walk a source
+image directory, optionally nearest-upsample small images, trim each image
+to a multiple of the crop size, tile it, and save each patch twice —
+original → ``a/``, bit-depth-quantized → ``b/`` — under
+``<out>/<split>/{a,b}/``. The reference caps patches per source image
+(max_patches, generate_dataset.py:87) and hardcodes 3 bits (line 90).
+
+This port runs the whole thing vectorized on numpy (one quantize per
+image, tiles via reshape — the reference loops PIL crops per patch) and
+parallelizes across source images with a process pool (the reference's
+multiprocessing scaffolding is commented out — generate_dataset.py:139-147).
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ProcessPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+IMG_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".webp")
+
+
+def is_image_file(name: str) -> bool:
+    """Extension whitelist (utils.py:5-6, case-insensitive superset)."""
+    return name.lower().endswith(IMG_EXTENSIONS)
+
+
+def compress_uint8(img: np.ndarray, bits: int = 3) -> np.ndarray:
+    """Bit-depth quantization on uint8 HWC images.
+
+    Matches compress() (generate_dataset.py:29-34) composed with the
+    ToTensor/save roundtrip: x/255 → round(x*(2^b-1))/(2^b-1) → *255.
+    """
+    n = float(2**bits - 1)
+    x = img.astype(np.float32) / 255.0
+    q = np.round(np.clip(x, 0.0, 1.0) * n) / n
+    return np.round(q * 255.0).astype(np.uint8)
+
+
+def _tile(img: np.ndarray, crop: int) -> np.ndarray:
+    """Trim to a multiple of ``crop`` and tile: (H,W,C) -> (T, crop, crop, C)."""
+    h, w, c = img.shape
+    th, tw = (h // crop) * crop, (w // crop) * crop
+    img = img[:th, :tw]
+    t = img.reshape(th // crop, crop, tw // crop, crop, c)
+    return t.transpose(0, 2, 1, 3, 4).reshape(-1, crop, crop, c)
+
+
+def generate_patches(
+    src_path: str,
+    a_dir: str,
+    b_dir: str,
+    crop_size: int = 256,
+    max_patches: int = 100,
+    bits: int = 3,
+    min_size: Optional[int] = None,
+) -> int:
+    """Tile one source image into paired patches. Returns patches written."""
+    img = Image.open(src_path).convert("RGB")
+    if min_size and min(img.size) < min_size:
+        # nearest upsample small sources (generate_dataset.py:60-64)
+        scale = int(np.ceil(min_size / min(img.size)))
+        img = img.resize((img.width * scale, img.height * scale), Image.NEAREST)
+    arr = np.asarray(img)
+    if arr.shape[0] < crop_size or arr.shape[1] < crop_size:
+        return 0
+    tiles = _tile(arr, crop_size)[:max_patches]
+    stem = os.path.splitext(os.path.basename(src_path))[0]
+    for i, patch in enumerate(tiles):
+        name = f"{stem}_{i:04d}.png"
+        Image.fromarray(patch).save(os.path.join(a_dir, name))
+        Image.fromarray(compress_uint8(patch, bits)).save(os.path.join(b_dir, name))
+    return len(tiles)
+
+
+def generate_dataset(
+    src_dir: str,
+    out_dir: str,
+    split: str = "train",
+    crop_size: int = 256,
+    max_patches: int = 100,
+    bits: int = 3,
+    min_size: Optional[int] = None,
+    workers: int = 0,
+) -> int:
+    """Generate <out>/<split>/{a,b}/ from every image under src_dir."""
+    a_dir = os.path.join(out_dir, split, "a")
+    b_dir = os.path.join(out_dir, split, "b")
+    os.makedirs(a_dir, exist_ok=True)
+    os.makedirs(b_dir, exist_ok=True)
+    if not os.path.isdir(src_dir):
+        raise RuntimeError(f"source folder {src_dir!r} does not exist")
+    sources = sorted(
+        os.path.join(src_dir, f) for f in os.listdir(src_dir) if is_image_file(f)
+    )
+    args = [(s, a_dir, b_dir, crop_size, max_patches, bits, min_size) for s in sources]
+    if workers and len(sources) > 1:
+        with ProcessPoolExecutor(max_workers=workers) as pool:
+            counts = list(pool.map(_gen_star, args))
+    else:
+        counts = [_gen_star(a) for a in args]
+    return int(sum(counts))
+
+
+def _gen_star(args) -> int:
+    return generate_patches(*args)

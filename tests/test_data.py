@@ -1,0 +1,96 @@
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from p2p_tpu.data import (
+    PairedImageDataset,
+    compress_uint8,
+    device_prefetch,
+    generate_dataset,
+    make_loader,
+    make_synthetic_dataset,
+    synthetic_batch,
+)
+
+
+def test_compress_uint8_levels():
+    img = np.arange(256, dtype=np.uint8).reshape(16, 16, 1).repeat(3, axis=2)
+    q = compress_uint8(img, 3)
+    assert len(np.unique(q)) <= 8  # 3 bits → ≤8 levels
+    # quantization is idempotent
+    np.testing.assert_array_equal(compress_uint8(q, 3), q)
+    # 1-bit: only 0 and 255
+    assert set(np.unique(compress_uint8(img, 1))) <= {0, 255}
+
+
+def test_generate_dataset_tiles_and_pairs(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        arr = rng.integers(0, 256, (70, 140, 3)).astype(np.uint8)
+        Image.fromarray(arr).save(src / f"img{i}.png")
+    out = tmp_path / "out"
+    n = generate_dataset(str(src), str(out), split="train", crop_size=32)
+    # 70x140 → 2x4 tiles per image × 2 images
+    assert n == 16
+    a_files = sorted(os.listdir(out / "train" / "a"))
+    b_files = sorted(os.listdir(out / "train" / "b"))
+    assert a_files == b_files and len(a_files) == 16
+    a0 = np.asarray(Image.open(out / "train" / "a" / a_files[0]))
+    b0 = np.asarray(Image.open(out / "train" / "b" / b_files[0]))
+    assert a0.shape == (32, 32, 3)
+    np.testing.assert_array_equal(b0, compress_uint8(a0, 3))
+
+
+def test_generate_dataset_missing_source_raises(tmp_path):
+    with pytest.raises(RuntimeError):
+        generate_dataset(str(tmp_path / "nope"), str(tmp_path / "out"))
+
+
+def test_paired_dataset_directions(tmp_path):
+    make_synthetic_dataset(str(tmp_path), n_train=4, n_test=2, size=32)
+    ds_b2a = PairedImageDataset(str(tmp_path), image_size=32, direction="b2a")
+    ds_a2b = PairedImageDataset(str(tmp_path), image_size=32, direction="a2b")
+    assert len(ds_b2a) == 4
+    it_b = ds_b2a[0]
+    it_a = ds_a2b[0]
+    np.testing.assert_array_equal(it_b["input"], it_a["target"])
+    np.testing.assert_array_equal(it_b["target"], it_a["input"])
+    assert it_b["input"].shape == (32, 32, 3)
+    assert it_b["input"].min() >= -1.0 and it_b["input"].max() <= 1.0
+    # b-side is quantized: few unique values
+    assert len(np.unique(it_b["input"])) <= 8 * 3
+
+
+def test_loader_batches_and_prefetch(tmp_path):
+    make_synthetic_dataset(str(tmp_path), n_train=6, n_test=2, size=32)
+    ds = PairedImageDataset(str(tmp_path), image_size=32)
+    batches = list(make_loader(ds, batch_size=2, shuffle=True, seed=1))
+    assert len(batches) == 3
+    assert batches[0]["input"].shape == (2, 32, 32, 3)
+    # device prefetch yields all batches as device arrays
+    out = list(device_prefetch(iter(batches)))
+    assert len(out) == 3
+    import jax
+
+    assert isinstance(out[0]["input"], jax.Array)
+
+
+def test_loader_deterministic_under_seed(tmp_path):
+    make_synthetic_dataset(str(tmp_path), n_train=6, n_test=2, size=32)
+    ds = PairedImageDataset(str(tmp_path), image_size=32)
+    b1 = [b["input"].sum() for b in make_loader(ds, 2, shuffle=True, seed=7)]
+    b2 = [b["input"].sum() for b in make_loader(ds, 2, shuffle=True, seed=7)]
+    np.testing.assert_allclose(b1, b2)
+
+
+def test_synthetic_batch_shapes():
+    b = synthetic_batch(batch_size=2, size=64)
+    assert b["input"].shape == (2, 64, 64, 3)
+    assert b["target"].shape == (2, 64, 64, 3)
+    assert -1.0 <= b["input"].min() and b["input"].max() <= 1.0
+    # input is a quantized version of target (same content, fewer levels)
+    assert len(np.unique(b["input"])) < len(np.unique(b["target"]))
