@@ -50,9 +50,9 @@ class MeshSpec:
                     f"{n_devices} devices not divisible by spatial*time={fixed}"
                 )
             d = n_devices // fixed
-        if d * s * t != n_devices:
+        if d * s * t > n_devices:
             raise ValueError(
-                f"mesh {d}x{s}x{t} != {n_devices} devices"
+                f"mesh {d}x{s}x{t} needs more than the {n_devices} devices available"
             )
         return d, s, t
 
@@ -70,7 +70,7 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     d, s, t = spec.resolve(len(devices))
-    dev_array = np.asarray(devices).reshape(d, s, t)
+    dev_array = np.asarray(devices[: d * s * t]).reshape(d, s, t)
     return Mesh(dev_array, axis_names=(DATA_AXIS, SPATIAL_AXIS, TIME_AXIS))
 
 

@@ -1,0 +1,13 @@
+from p2p_tpu.train.schedules import lambda_rule, make_schedule, PlateauController
+from p2p_tpu.train.state import TrainState, create_train_state
+from p2p_tpu.train.step import build_eval_step, build_train_step
+
+__all__ = [
+    "lambda_rule",
+    "make_schedule",
+    "PlateauController",
+    "TrainState",
+    "create_train_state",
+    "build_train_step",
+    "build_eval_step",
+]

@@ -1,0 +1,63 @@
+"""Checkpointing — Orbax, one pytree, exact round-trip.
+
+The reference's checkpointing is broken as shipped: the saver writes
+``{epoch, state_dict_g, state_dict_c}`` (train.py:514-524) while the loader
+demands eight keys including D/optimizers/schedulers (train.py:110-116 —
+KeyError on any real checkpoint, SURVEY Q4), and test.py expects a pickled
+module under a filename train.py never writes (Q5). Here the WHOLE
+TrainState (all params, BN stats, spectral u/v, all three optimizer states,
+step) is one Orbax pytree: what is saved is what is restored, verified
+bitwise by tests/test_train.py::test_checkpoint_roundtrip.
+
+Orbax gives async save (non-blocking on TPU), restore-to-sharding (pass the
+mesh-placed abstract state and arrays land already sharded), and retention
+policies — the TPU-native story for the failure-recovery subsystem
+(SURVEY §5.3/5.4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from p2p_tpu.train.state import TrainState
+
+
+class CheckpointManager:
+    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        directory = os.path.abspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, wait: bool = False) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, state_template: TrainState, step: Optional[int] = None):
+        """Restore into the structure/sharding of ``state_template``."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                          state_template)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,83 @@
+"""Learning-rate schedules — exact reference policies, expressed per-step.
+
+Reference ``get_scheduler`` (networks.py:104-118), stepped once per epoch
+(networks.py:122-125):
+
+- ``lambda``  multiplier 1 − max(0, e + epoch_count − niter)/(niter_decay+1)
+- ``step``    ×0.1 every ``lr_decay_iters`` epochs
+- ``plateau`` ReduceLROnPlateau(min, factor=0.2, threshold=0.01, patience=5)
+- ``cosine``  CosineAnnealingLR(T_max=niter, eta_min=0)
+
+Under jit the schedule must be a pure function of the step counter, so
+epoch-wise policies take ``steps_per_epoch`` and floor-divide. ``plateau``
+is inherently metric-driven, so it lives host-side as
+:class:`PlateauController` feeding an ``optax.inject_hyperparams`` scale.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+
+from p2p_tpu.core.config import OptimConfig
+
+
+def lambda_rule(epoch, epoch_count: int, niter: int, niter_decay: int):
+    """The reference's linear-decay multiplier (networks.py:106-109)."""
+    return 1.0 - jnp.maximum(0.0, epoch + epoch_count - niter) / float(
+        niter_decay + 1
+    )
+
+
+def make_schedule(cfg: OptimConfig, steps_per_epoch: int,
+                  epoch_count: int = 1) -> Callable:
+    """Per-step lr schedule implementing the epoch-wise reference policies.
+
+    ``epoch_count`` is the 1-based starting epoch (resume offset), as in the
+    reference's ``--epoch_count`` flag.
+    """
+    base = cfg.lr
+
+    def schedule(step):
+        epoch = jnp.asarray(step) // steps_per_epoch
+        if cfg.lr_policy == "lambda":
+            mult = lambda_rule(epoch, epoch_count, cfg.niter, cfg.niter_decay)
+        elif cfg.lr_policy == "step":
+            mult = 0.1 ** (epoch // cfg.lr_decay_iters)
+        elif cfg.lr_policy == "cosine":
+            mult = 0.5 * (1.0 + jnp.cos(jnp.pi * epoch / cfg.niter))
+        elif cfg.lr_policy == "plateau":
+            mult = 1.0  # host-controlled via PlateauController
+        else:
+            raise ValueError(f"unknown lr policy {cfg.lr_policy!r}")
+        return base * mult
+
+    return schedule
+
+
+class PlateauController:
+    """Host-side ReduceLROnPlateau with the reference's hyperparameters
+    (mode='min', factor=0.2, threshold=0.01 relative, patience=5)."""
+
+    def __init__(self, factor: float = 0.2, threshold: float = 0.01,
+                 patience: int = 5):
+        self.factor = factor
+        self.threshold = threshold
+        self.patience = patience
+        self.best = math.inf
+        self.bad_epochs = 0
+        self.scale = 1.0
+
+    def update(self, metric: float) -> float:
+        """Feed one epoch's metric; returns the current lr scale."""
+        if metric < self.best * (1.0 - self.threshold):
+            self.best = metric
+            self.bad_epochs = 0
+        else:
+            self.bad_epochs += 1
+            if self.bad_epochs > self.patience:
+                self.scale *= self.factor
+                self.bad_epochs = 0
+        return self.scale

@@ -1,0 +1,3 @@
+from p2p_tpu.utils.images import save_img, to_uint8_img
+
+__all__ = ["save_img", "to_uint8_img"]

@@ -1,0 +1,27 @@
+"""Host-side image IO.
+
+Parity with /root/reference/utils.py: ``save_img`` maps [-1,1] → uint8 via
+(x+1)/2·255 (utils.py:15-22 — the CORRECT mapping, which the reference's
+train-time ``tensor2img`` disagrees with, SURVEY Q8). Arrays here are NHWC
+or HWC numpy/JAX; no CHW anywhere.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from PIL import Image
+
+
+def to_uint8_img(x) -> np.ndarray:
+    """[-1,1] float HWC → uint8 HWC."""
+    arr = np.asarray(x, np.float32)
+    if arr.ndim == 4:
+        if arr.shape[0] != 1:
+            raise ValueError(f"expected single image, got batch {arr.shape}")
+        arr = arr[0]
+    arr = (arr + 1.0) * 0.5 * 255.0
+    return np.clip(np.round(arr), 0, 255).astype(np.uint8)
+
+
+def save_img(x, path: str) -> None:
+    Image.fromarray(to_uint8_img(x)).save(path)

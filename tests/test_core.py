@@ -17,9 +17,12 @@ def test_mesh_shapes(devices8):
 
 def test_mesh_bad_shape(devices8):
     with pytest.raises(ValueError):
-        make_mesh(MeshSpec(data=3, spatial=2), devices=devices8)
+        make_mesh(MeshSpec(data=3, spatial=3), devices=devices8)  # 9 > 8
     with pytest.raises(ValueError):
-        make_mesh(MeshSpec(data=-1, spatial=3), devices=devices8)
+        make_mesh(MeshSpec(data=-1, spatial=3), devices=devices8)  # 8 % 3
+    # explicit sub-mesh is allowed: uses the first d*s*t devices
+    m = make_mesh(MeshSpec(data=2, spatial=2), devices=devices8)
+    assert m.shape == {"data": 2, "spatial": 2, "time": 1}
 
 
 def test_shardings_build(devices8):
