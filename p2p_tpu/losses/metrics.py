@@ -31,12 +31,18 @@ def to_uint8_space(x: jax.Array, ref_buggy_scale: bool = False,
 
 
 def psnr(target: jax.Array, pred: jax.Array, ref_buggy_scale: bool = False,
-         max_db: float = 60.0) -> jax.Array:
+         max_db: float = 60.0, per_image: bool = False) -> jax.Array:
     """10·log10(255²/MSE), clamped to ``max_db`` (the reference clamps its
-    Inf-PSNR readings to 60.0 — train.py:480-482)."""
+    Inf-PSNR readings to 60.0 — train.py:480-482).
+
+    ``per_image=True`` reduces over HWC only, returning one value per batch
+    element — needed for the reference's per-image max-PSNR report
+    (train.py:498-502) at test_batch_size > 1.
+    """
     t = to_uint8_space(target, ref_buggy_scale)
     p = to_uint8_space(pred, ref_buggy_scale)
-    mse = jnp.mean((t - p) ** 2)
+    axes = tuple(range(1, t.ndim)) if per_image else None
+    mse = jnp.mean((t - p) ** 2, axis=axes)
     val = 10.0 * jnp.log10(255.0**2 / jnp.maximum(mse, 1e-12))
     return jnp.minimum(val, max_db)
 
@@ -54,7 +60,7 @@ def _uniform_window(x: jax.Array, win: int) -> jax.Array:
 
 
 def ssim(target: jax.Array, pred: jax.Array, ref_buggy_scale: bool = False,
-         win: int = 7) -> jax.Array:
+         win: int = 7, per_image: bool = False) -> jax.Array:
     """Mean SSIM with a uniform win×win window, matching
     skimage.metrics.structural_similarity defaults for uint8 inputs
     (win=7, uniform filter, L=255, K1=0.01, K2=0.03, multichannel mean) —
@@ -73,4 +79,7 @@ def ssim(target: jax.Array, pred: jax.Array, ref_buggy_scale: bool = False,
     cov = cov_norm * (_uniform_window(t * p, win) - mu_t * mu_p)
     num = (2 * mu_t * mu_p + c1) * (2 * cov + c2)
     den = (mu_t**2 + mu_p**2 + c1) * (var_t + var_p + c2)
-    return jnp.mean(num / den)
+    smap = num / den
+    if per_image:
+        return jnp.mean(smap, axis=tuple(range(1, smap.ndim)))
+    return jnp.mean(smap)

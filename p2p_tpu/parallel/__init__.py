@@ -1,0 +1,55 @@
+"""Parallelism strategies over the global device mesh (SURVEY.md §2.4).
+
+- ``dp``       data parallelism (+ mixed data×spatial) via sharding
+               annotations on the jitted train step; GSPMD collectives.
+- ``spatial``  GSPMD spatial sharding of H with explicit shard_map halo
+               exchange for the stride-1 conv trunk.
+- ``temporal`` sequence parallelism over video frames for the vid2vid
+               temporal discriminator.
+- ``halo``     the shared nearest-neighbor ppermute halo-exchange primitive.
+
+Not applicable to this model family (documented, per SURVEY §2.4): expert
+parallelism (no MoE), ring/Ulysses attention (no attention ops — the
+spatial/temporal halo exchange is the conv equivalent). Pipeline parallelism
+is out of scope v1; the mesh reserves no axis for it but ``MeshSpec`` is the
+single place to add one.
+"""
+
+from p2p_tpu.parallel.dp import (
+    make_parallel_eval_step,
+    make_parallel_train_step,
+    replicate_state,
+    shard_batch,
+)
+from p2p_tpu.parallel.halo import halo_exchange, ring_shift
+from p2p_tpu.parallel.spatial import (
+    check_spatial_divisible,
+    conv2d_local,
+    make_sharded_conv,
+    sharded_conv2d,
+    spatial_activation_sharding,
+)
+from p2p_tpu.parallel.temporal import (
+    gather_frames,
+    make_sharded_temporal_conv,
+    sharded_temporal_conv3d,
+    temporal_mean,
+)
+
+__all__ = [
+    "make_parallel_eval_step",
+    "make_parallel_train_step",
+    "replicate_state",
+    "shard_batch",
+    "halo_exchange",
+    "ring_shift",
+    "check_spatial_divisible",
+    "conv2d_local",
+    "make_sharded_conv",
+    "sharded_conv2d",
+    "spatial_activation_sharding",
+    "gather_frames",
+    "make_sharded_temporal_conv",
+    "sharded_temporal_conv3d",
+    "temporal_mean",
+]

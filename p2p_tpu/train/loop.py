@@ -141,21 +141,27 @@ class Trainer:
             seed=cfg.train.seed + seed, num_workers=cfg.data.threads
             if len(self.train_ds) > 64 else 0,
         )
-        sums: Dict[str, float] = {}
+        # Keep device scalars per step (no host sync mid-epoch) and reduce
+        # once at the end, so epoch averages cover EVERY step regardless of
+        # log_every.
+        accum: List[Dict[str, jax.Array]] = []
         count = 0
         for batch in device_prefetch(loader, self.batch_sharding):
             self.state, metrics = self.train_step(self.state, batch)
+            accum.append(metrics)
             count += 1
             if count % cfg.train.log_every == 0:
                 host = {k: float(v) for k, v in metrics.items()}
-                for k, v in host.items():
-                    sums[k] = sums.get(k, 0.0) + v
                 self.logger.log(
                     {"kind": "train", "epoch": self.epoch,
                      "step": int(self.state.step), **host}
                 )
-        n = max(1, count // cfg.train.log_every)
-        return {k: v / n for k, v in sums.items()}
+        if not accum:
+            return {}
+        return {
+            k: float(np.mean([np.asarray(m[k]) for m in accum]))
+            for k in accum[0]
+        }
 
     def evaluate(self, save_samples: bool = False) -> Dict[str, float]:
         cfg = self.cfg
@@ -168,8 +174,10 @@ class Trainer:
         sample_saved = False
         for batch in device_prefetch(loader, self.batch_sharding):
             pred, metrics = self.eval_step(self.state, batch)
-            psnrs.append(float(metrics["psnr"]))
-            ssims.append(float(metrics["ssim"]))
+            # per-image vectors → the max below is over individual images,
+            # matching the reference report (train.py:498-502)
+            psnrs.extend(np.asarray(metrics["psnr"]).ravel().tolist())
+            ssims.extend(np.asarray(metrics["ssim"]).ravel().tolist())
             if save_samples and not sample_saved:
                 out_dir = os.path.join(
                     self.workdir, cfg.train.result_dir, cfg.data.dataset
@@ -203,9 +211,16 @@ class Trainer:
             if cfg.train.eval_every_epoch:
                 record.update(self.evaluate(save_samples=True))
             history.append(record)
-            if self.plateau is not None:
-                # feed the generator loss, mode='min' (reference plateau)
-                self.plateau.update(record.get("loss_g", 0.0))
+            if self.plateau is not None and "loss_g" in record:
+                # feed the generator loss, mode='min' (reference plateau);
+                # the returned scale multiplies every optimizer update
+                # inside the jitted step via TrainState.lr_scale.
+                scale = self.plateau.update(record["loss_g"])
+                import jax.numpy as jnp
+
+                self.state = self.state.replace(
+                    lr_scale=jnp.asarray(scale, jnp.float32)
+                )
             if self.epoch % cfg.train.epoch_save == 0 or self.epoch == nepoch:
                 self.ckpt.save(int(self.state.step), self.state)
             self.epoch += 1

@@ -22,6 +22,9 @@ from p2p_tpu.models.registry import define_C, define_D, define_G, init_variables
 
 class TrainState(struct.PyTreeNode):
     step: jax.Array
+    # Host-controlled LR multiplier (the 'plateau' policy's knob; 1.0
+    # otherwise). Applied to every optimizer update inside the step.
+    lr_scale: jax.Array
     # generator
     params_g: Any
     batch_stats_g: Any
@@ -88,6 +91,7 @@ def create_train_state(
 
     return TrainState(
         step=jnp.zeros((), jnp.int32),
+        lr_scale=jnp.ones((), jnp.float32),
         params_g=vg["params"],
         batch_stats_g=vg.get("batch_stats", {}),
         opt_g=opt_g.init(vg["params"]),

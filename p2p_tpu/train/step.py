@@ -30,8 +30,7 @@ whole step is one XLA program: no host round-trips between "optimizers".
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -167,10 +166,16 @@ def build_train_step(
         )(state.params_g)
 
         # ---- 4. apply G then D updates (reference order) ----------------
+        # lr_scale: Adam updates are linear in lr, so the host-driven
+        # plateau multiplier is applied to the update trees directly.
+        scale = state.lr_scale.astype(jnp.float32)
+        scale_tree = lambda ups: jax.tree_util.tree_map(  # noqa: E731
+            lambda u: u * scale.astype(u.dtype), ups
+        )
         up_g, opt_g1 = opt_g.update(grads_g, state.opt_g, state.params_g)
-        params_g1 = optax.apply_updates(state.params_g, up_g)
+        params_g1 = optax.apply_updates(state.params_g, scale_tree(up_g))
         up_d, opt_d1 = opt_d.update(grads_d, state.opt_d, state.params_d)
-        params_d1 = optax.apply_updates(state.params_d, up_d)
+        params_d1 = optax.apply_updates(state.params_d, scale_tree(up_d))
 
         # ---- 5. compression branch vs the UPDATED generator -------------
         loss_c = jnp.zeros((), jnp.float32)
@@ -193,7 +198,7 @@ def build_train_step(
             )(state.params_c)
             if cfg.optim.train_compression_net:
                 up_c, opt_c1 = opt_c.update(grads_c, state.opt_c, state.params_c)
-                params_c1 = optax.apply_updates(state.params_c, up_c)
+                params_c1 = optax.apply_updates(state.params_c, scale_tree(up_c))
 
         new_state = state.replace(
             step=state.step + 1,
@@ -250,9 +255,12 @@ def build_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
             {"params": state.params_g, "batch_stats": state.batch_stats_g},
             g_in, False,
         )
+        # Per-image vectors so the driver can report the reference's
+        # mean AND max over individual test images (train.py:498-502)
+        # even at test_batch_size > 1.
         metrics = {
-            "psnr": psnr(real_b, pred),
-            "ssim": ssim(real_b, pred),
+            "psnr": psnr(real_b, pred, per_image=True),
+            "ssim": ssim(real_b, pred, per_image=True),
         }
         return pred, metrics
 
