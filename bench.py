@@ -1,0 +1,78 @@
+"""Benchmark: training throughput (img/sec/chip) on the flagship config.
+
+Runs the full jitted alternating-GAN train step (G+D+C updates, LSGAN +
+feature-matching + VGG19-perceptual + TV losses, STE quantizer, spectral
+norm) on 256x256 synthetic pairs — the reference's workload (train.py hot
+loop, SURVEY §3.1) at the north-star metric: images/sec/chip vs the
+BASELINE.json target of 2000 img/s/chip on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Env knobs: BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG (image size).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.models.vgg import load_vgg19_params
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
+    bs = int(os.environ.get("BENCH_BS", "8" if on_tpu else "2"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
+    warmup = max(2, n_steps // 10)
+
+    import dataclasses
+
+    cfg = get_preset("reference")
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, batch_size=bs, image_size=img)
+    )
+    dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
+
+    host = synthetic_batch(batch_size=bs, size=img, bits=cfg.model.quant_bits)
+    batch = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
+
+    state = create_train_state(cfg, jax.random.key(0), batch, train_dtype=dtype)
+    vgg_params = load_vgg19_params(jnp.bfloat16 if dtype is not None else jnp.float32)
+    step = build_train_step(cfg, vgg_params, train_dtype=dtype)
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    img_per_sec = bs * n_steps / elapsed
+    baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 on TPU
+    # only a real-TPU 256^2 run is comparable to the baseline number
+    comparable = on_tpu and img == 256
+    print(json.dumps({
+        "metric": f"train_throughput_{platform}_{img}px_bs{bs}",
+        "value": round(img_per_sec, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(img_per_sec / baseline, 4) if comparable else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()
