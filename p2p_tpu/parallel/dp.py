@@ -22,7 +22,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from p2p_tpu.core.config import Config
 from p2p_tpu.core.mesh import batch_sharding, replicated, video_sharding

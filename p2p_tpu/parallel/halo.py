@@ -18,8 +18,10 @@ Edge policy matches the conv padding being reproduced:
   framework's ReflectionPad convs (ref networks.py:395-405) exactly.
 - ``"zero"``    — zero padding (PatchGAN convs, temporal conv boundaries).
 - ``"wrap"``    — periodic; the raw ppermute ring result.
-- ``"none"``    — no outer padding: outer shards get a smaller result
-  (VALID-style convs); caller handles the rank bookkeeping.
+
+(shard_map outputs must be shape-uniform across shards, so a VALID-style
+"no outer padding" mode is not expressible here — callers wanting VALID
+convs slice the edge shards' output instead.)
 """
 
 from __future__ import annotations
@@ -81,8 +83,6 @@ def halo_exchange(
         )
         lo_halo = jnp.where(idx == 0, lo_reflect, from_prev)
         hi_halo = jnp.where(idx == n - 1, hi_reflect, from_next)
-    elif edge_mode == "none":
-        lo_halo, hi_halo = from_prev, from_next
     else:
         raise ValueError(f"unknown edge_mode {edge_mode!r}")
 

@@ -28,7 +28,6 @@ keeps each message at ~W×128×4 bytes — latency-bound but overlappable.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,13 +49,14 @@ def conv2d_local(
     w_pad_mode: str = "reflect",
 ) -> jax.Array:
     """Plain local conv, H already halo-padded; W padded locally (unsharded)."""
-    kh, kw = kernel.shape[0], kernel.shape[1]
-    pw = kw // 2
+    pw = kernel.shape[1] // 2
     if pw:
         if w_pad_mode == "reflect":
             x = jnp.pad(x, ((0, 0), (0, 0), (pw, pw), (0, 0)), mode="reflect")
         elif w_pad_mode == "zero":
             x = jnp.pad(x, ((0, 0), (0, 0), (pw, pw), (0, 0)))
+        elif w_pad_mode == "wrap":
+            x = jnp.pad(x, ((0, 0), (0, 0), (pw, pw), (0, 0)), mode="wrap")
         else:
             raise ValueError(f"unknown w_pad_mode {w_pad_mode!r}")
     dn = lax.conv_dimension_numbers(x.shape, kernel.shape, _DIMNUMS)

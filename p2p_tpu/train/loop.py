@@ -132,6 +132,10 @@ class Trainer:
             return False
         self.state = self.ckpt.restore(self.state)
         self.epoch = 1 + int(step) // self.steps_per_epoch
+        if self.plateau is not None:
+            # lr_scale only ever decreases; seed the fresh controller from
+            # the restored state so resume doesn't undo prior reductions.
+            self.plateau.scale = float(np.asarray(self.state.lr_scale))
         return True
 
     def train_epoch(self, seed: int = 0) -> Dict[str, float]:
@@ -141,14 +145,16 @@ class Trainer:
             seed=cfg.train.seed + seed, num_workers=cfg.data.threads
             if len(self.train_ds) > 64 else 0,
         )
-        # Keep device scalars per step (no host sync mid-epoch) and reduce
-        # once at the end, so epoch averages cover EVERY step regardless of
-        # log_every.
-        accum: List[Dict[str, jax.Array]] = []
+        # Keep a device-side running sum (no host sync mid-epoch, no buffer
+        # pile-up) and transfer ONCE at epoch end, so averages cover EVERY
+        # step regardless of log_every.
+        sums: Optional[Dict[str, jax.Array]] = None
         count = 0
         for batch in device_prefetch(loader, self.batch_sharding):
             self.state, metrics = self.train_step(self.state, batch)
-            accum.append(metrics)
+            sums = metrics if sums is None else jax.tree_util.tree_map(
+                jax.numpy.add, sums, metrics
+            )
             count += 1
             if count % cfg.train.log_every == 0:
                 host = {k: float(v) for k, v in metrics.items()}
@@ -156,12 +162,10 @@ class Trainer:
                     {"kind": "train", "epoch": self.epoch,
                      "step": int(self.state.step), **host}
                 )
-        if not accum:
+        if sums is None:
             return {}
-        return {
-            k: float(np.mean([np.asarray(m[k]) for m in accum]))
-            for k in accum[0]
-        }
+        host_sums = jax.device_get(sums)
+        return {k: float(v) / count for k, v in host_sums.items()}
 
     def evaluate(self, save_samples: bool = False) -> Dict[str, float]:
         cfg = self.cfg
