@@ -47,6 +47,9 @@ class ModelConfig:
     quant_ste: bool = True
     # "batch" | "instance" | "pallas_instance"
     norm: str = "batch"
+    # U-Net decoder dropout (the pix2pix noise source). The train step
+    # threads a per-step dropout rng when this is on.
+    use_dropout: bool = False
     init_type: str = "normal"   # normal | xavier | kaiming | orthogonal
     init_gain: float = 0.02
     # vid2vid temporal discriminator window (frames)
@@ -154,7 +157,8 @@ _register(
     Config(
         name="facades",
         model=ModelConfig(generator="unet", ngf=64, num_D=1, n_layers_D=3,
-                          use_spectral_norm=False, use_compression_net=False),
+                          use_spectral_norm=False, use_compression_net=False,
+                          use_dropout=True),
         loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
                         lambda_l1=100.0),
         data=DataConfig(dataset="facades", image_size=256, batch_size=1),
@@ -179,7 +183,8 @@ _register(
     Config(
         name="edges2shoes_dp",
         model=ModelConfig(generator="unet", ngf=64, num_D=1, n_layers_D=3,
-                          use_spectral_norm=False, use_compression_net=False),
+                          use_spectral_norm=False, use_compression_net=False,
+                          use_dropout=True),
         loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
                         lambda_l1=100.0),
         data=DataConfig(dataset="edges2shoes", image_size=256, batch_size=64),

@@ -1,6 +1,9 @@
 from p2p_tpu.models.compression import CompressionNetwork
 from p2p_tpu.models.expand import ExpandNetwork, ResidualBlock
 from p2p_tpu.models.patchgan import MultiscaleDiscriminator, NLayerDiscriminator
+from p2p_tpu.models.pix2pixhd import GlobalGenerator, Pix2PixHDGenerator
+from p2p_tpu.models.resnet_gen import ResnetBlock, ResnetGenerator
+from p2p_tpu.models.unet import UNetGenerator
 from p2p_tpu.models.vgg import VGG19Features
 from p2p_tpu.models.registry import define_C, define_D, define_G
 
@@ -10,6 +13,11 @@ __all__ = [
     "ResidualBlock",
     "MultiscaleDiscriminator",
     "NLayerDiscriminator",
+    "GlobalGenerator",
+    "Pix2PixHDGenerator",
+    "ResnetBlock",
+    "ResnetGenerator",
+    "UNetGenerator",
     "VGG19Features",
     "define_C",
     "define_D",

@@ -40,7 +40,8 @@ def define_G(cfg: ModelConfig, dtype=None, remat: bool = False) -> nn.Module:
         from p2p_tpu.models.unet import UNetGenerator
 
         return UNetGenerator(
-            ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm, dtype=dtype
+            ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm,
+            use_dropout=cfg.use_dropout, dtype=dtype,
         )
     if cfg.generator == "resnet":
         from p2p_tpu.models.resnet_gen import ResnetGenerator
@@ -57,7 +58,8 @@ def define_G(cfg: ModelConfig, dtype=None, remat: bool = False) -> nn.Module:
         from p2p_tpu.models.pix2pixhd import Pix2PixHDGenerator
 
         return Pix2PixHDGenerator(
-            ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm,
+            ngf=cfg.ngf, out_channels=cfg.output_nc,
+            n_blocks_global=cfg.n_blocks, norm=cfg.norm,
             remat=remat, dtype=dtype,
         )
     raise ValueError(f"unknown generator {cfg.generator!r}")

@@ -1,0 +1,86 @@
+"""ResNet generator — the commented-out alternative of the reference
+(networks.py:168 ``ResnetGenerator``; Johnson-style transform net used by
+pix2pix/CycleGAN) and the G of the Cityscapes spatial-shard preset.
+
+c7s1-ngf → 2× stride-2 down conv (k3) → ``n_blocks`` residual blocks →
+2× resize-conv up → c7s1-out, tanh. All convs reflection-padded; norm/ReLU
+after every conv. Unlike ExpandNetwork's ResidualBlock (relu after add,
+networks.py:429-444), the classic ResnetBlock has NO activation after the
+residual add.
+
+TPU-first: the residual trunk (the FLOPs bulk) runs in bf16 on the MXU and
+is optionally rematerialized; upsampling is nearest-resize + conv.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer
+from p2p_tpu.ops.norm import make_norm
+
+
+class ResnetBlock(nn.Module):
+    """reflectpad-conv-norm-relu-reflectpad-conv-norm + identity (no final
+    activation)."""
+
+    features: int
+    norm: str = "instance"
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(x)
+        y = nn.relu(mk()(y))
+        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
+        y = mk()(y)
+        return x + y
+
+
+class ResnetGenerator(nn.Module):
+    """``max_features`` caps channel growth (pix2pixHD's G1 uses 1024);
+    ``return_features`` skips the c7s1-out head and returns the ngf-channel
+    feature map (the pix2pixHD enhancer taps it)."""
+
+    ngf: int = 64
+    n_blocks: int = 9
+    out_channels: int = 3
+    n_downsampling: int = 2
+    norm: str = "instance"
+    max_features: Optional[int] = None
+    return_features: bool = False
+    remat: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        cap = self.max_features or (1 << 30)
+
+        y = ConvLayer(self.ngf, kernel_size=7, dtype=self.dtype)(x)
+        y = nn.relu(mk()(y))
+        for i in range(self.n_downsampling):
+            f = min(self.ngf * (2 ** (i + 1)), cap)
+            y = ConvLayer(f, kernel_size=3, stride=2, dtype=self.dtype)(y)
+            y = nn.relu(mk()(y))
+
+        block_cls = ResnetBlock
+        if self.remat:
+            block_cls = nn.remat(ResnetBlock, static_argnums=(2,))
+        f_trunk = min(self.ngf * (2 ** self.n_downsampling), cap)
+        for _ in range(self.n_blocks):
+            y = block_cls(f_trunk, norm=self.norm, dtype=self.dtype)(y, train)
+
+        for i in reversed(range(self.n_downsampling)):
+            f = min(self.ngf * (2 ** i), cap)
+            y = UpsampleConvLayer(f, kernel_size=3, upsample=2,
+                                  dtype=self.dtype)(y)
+            y = nn.relu(mk()(y))
+        if self.return_features:
+            return y
+        y = ConvLayer(self.out_channels, kernel_size=7, dtype=self.dtype)(y)
+        return jnp.tanh(y)

@@ -1,0 +1,88 @@
+"""U-Net generator — classic pix2pix (the BASELINE facades/edges2shoes
+configs; the reference's BASELINE.json mislabels its ExpandNetwork a
+"U-Net", see SURVEY §0 — this is the real one).
+
+Architecture follows the pix2pix U-Net-256: ``num_downs`` stride-2 encoder
+convs (k4) with LeakyReLU(0.2), channel growth ngf→8·ngf (capped), skip
+connections at every resolution, decoder mirrors with norm+ReLU, tanh head.
+Innermost and outermost levels carry no norm, as in the original.
+
+TPU-first deviations from the torch lineage (semantics, not translation):
+- Decoder upsampling is nearest-resize + conv k3 (MXU-friendly, no
+  checkerboard) instead of ConvTranspose2d k4 s2 — the same choice the
+  reference made for its own decoder (networks.py:408-423).
+- Dropout (the pix2pix noise source, 0.5 on the three innermost decoder
+  levels) is off by default; when ``use_dropout`` is set the caller passes
+  an ``rngs={'dropout': ...}`` to apply().
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.ops.conv import UpsampleConvLayer, normal_init
+from p2p_tpu.ops.norm import make_norm
+
+
+class UNetGenerator(nn.Module):
+    ngf: int = 64
+    out_channels: int = 3
+    num_downs: int = 8         # 256x256 → 1x1 bottleneck
+    norm: str = "batch"
+    use_dropout: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        # Shapes are static under jit: clamp the depth to the factor-of-2
+        # content of H and W so every decoder upsample exactly mirrors its
+        # encoder level (96 = 2^5·3 → 5 levels, 3px bottleneck).
+        def pow2_levels(n: int) -> int:
+            k = 0
+            while n % 2 == 0 and n > 1:
+                n //= 2
+                k += 1
+            return k
+
+        num_downs = min(self.num_downs, pow2_levels(x.shape[1]),
+                        pow2_levels(x.shape[2]))
+
+        def down_conv(y, features, name):
+            return nn.Conv(
+                features, kernel_size=(4, 4), strides=(2, 2), padding=1,
+                dtype=self.dtype, kernel_init=normal_init(), name=name,
+            )(y)
+
+        # ---- encoder ----------------------------------------------------
+        feats = [min(self.ngf * (2 ** i), self.ngf * 8)
+                 for i in range(num_downs)]
+        skips = []
+        y = x
+        for i, f in enumerate(feats):
+            if i > 0:
+                y = nn.leaky_relu(y, negative_slope=0.2)
+            y = down_conv(y, f, name=f"down{i}")
+            # no norm on the outermost and innermost encoder convs
+            if 0 < i < num_downs - 1:
+                y = mk()(y)
+            skips.append(y)
+
+        # ---- decoder ----------------------------------------------------
+        for i in reversed(range(num_downs)):
+            f = self.out_channels if i == 0 else feats[i - 1]
+            y = nn.relu(y)
+            y = UpsampleConvLayer(
+                f, kernel_size=3, upsample=2, dtype=self.dtype,
+                name=f"up{i}",
+            )(y)
+            if i > 0:
+                y = mk()(y)
+                # dropout on the three decoder levels after the innermost
+                if self.use_dropout and num_downs - 4 <= i < num_downs - 1:
+                    y = nn.Dropout(0.5, deterministic=not train)(y)
+                y = jnp.concatenate([y, skips[i - 1]], axis=-1)
+        return jnp.tanh(y)

@@ -69,10 +69,13 @@ def build_train_step(
     use_c = cfg.model.use_compression_net
     need_vgg = (L.lambda_vgg > 0) and vgg_params is not None
 
-    def g_fwd(params, bstats, x):
+    use_dropout = cfg.model.use_dropout
+
+    def g_fwd(params, bstats, x, rng=None):
+        rngs = {"dropout": rng} if (use_dropout and rng is not None) else None
         return g.apply(
             {"params": params, "batch_stats": bstats}, x, True,
-            mutable=["batch_stats"],
+            mutable=["batch_stats"], rngs=rngs,
         )
 
     def d_fwd(params, spectral, x):
@@ -102,8 +105,17 @@ def build_train_step(
 
         g_input = jax.lax.stop_gradient(compressed)
 
+        # per-step dropout noise (pix2pix's noise source); the SAME key in
+        # the primal and loss-graph G forwards keeps them CSE-identical
+        drop_rng = (
+            jax.random.fold_in(jax.random.key(cfg.train.seed), state.step)
+            if use_dropout else None
+        )
+
         # primal G forward (value shared with both loss graphs via CSE)
-        fake_b_primal, vg1 = g_fwd(state.params_g, state.batch_stats_g, g_input)
+        fake_b_primal, vg1 = g_fwd(
+            state.params_g, state.batch_stats_g, g_input, drop_rng
+        )
         bs_g1 = vg1["batch_stats"]
 
         # ---- 2. discriminator loss --------------------------------------
@@ -128,7 +140,7 @@ def build_train_step(
 
         # ---- 3. generator loss ------------------------------------------
         def loss_g_fn(params_g):
-            fake_b, _ = g_fwd(params_g, state.batch_stats_g, g_input)
+            fake_b, _ = g_fwd(params_g, state.batch_stats_g, g_input, drop_rng)
             pred_fake_g, s3 = d_fwd(
                 jax.lax.stop_gradient(state.params_d),
                 spectral1,
@@ -183,7 +195,9 @@ def build_train_step(
         if use_c:
             def loss_c_fn(params_c):
                 cq, _ = compressed_fn(params_c)
-                fake_ac, vg2 = g_fwd(params_g1, bs_g1, cq)
+                c_rng = (jax.random.fold_in(drop_rng, 1)
+                         if drop_rng is not None else None)
+                fake_ac, vg2 = g_fwd(params_g1, bs_g1, cq, c_rng)
                 loss = jnp.mean(
                     (fake_ac.astype(jnp.float32) - real_b.astype(jnp.float32)) ** 2
                 )

@@ -6,6 +6,10 @@ import pytest
 from p2p_tpu.core.config import ModelConfig
 from p2p_tpu.models import (
     CompressionNetwork,
+    GlobalGenerator,
+    Pix2PixHDGenerator,
+    ResnetGenerator,
+    UNetGenerator,
     ExpandNetwork,
     MultiscaleDiscriminator,
     NLayerDiscriminator,
@@ -113,3 +117,123 @@ def test_vgg_fallback_is_deterministic():
     l2 = jax.tree_util.tree_leaves(p2)
     for a, b in zip(l1, l2):
         np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------- new G families
+
+def test_unet_generator_shapes_skips_and_grads():
+    x = jnp.asarray(
+        np.random.default_rng(3).uniform(-1, 1, (2, 64, 64, 3)), jnp.float32
+    )
+    net = UNetGenerator(ngf=8)
+    variables = net.init(jax.random.key(0), x, True)
+    y, _ = net.apply(variables, x, True, mutable=["batch_stats"])
+    assert y.shape == x.shape
+    assert float(jnp.max(jnp.abs(y))) <= 1.0
+    # depth clamps to log2(64)=6 levels on a 64px input
+    downs = [k for k in variables["params"] if k.startswith("down")]
+    assert len(downs) == 6
+    # gradients flow through every encoder conv (skip connections intact)
+    def loss(p):
+        out, _ = net.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]}, x, True,
+            mutable=["batch_stats"],
+        )
+        return jnp.mean(out**2)
+    grads = jax.grad(loss)(variables["params"])
+    for name in downs:
+        g = np.asarray(grads[name]["kernel"])
+        assert np.abs(g).sum() > 0, f"no grad into {name}"
+
+
+def test_unet_inference_mode_no_mutation():
+    x = jnp.asarray(
+        np.random.default_rng(4).uniform(-1, 1, (1, 32, 32, 3)), jnp.float32
+    )
+    net = UNetGenerator(ngf=4)
+    variables = net.init(jax.random.key(0), x, True)
+    y = net.apply(variables, x, False)  # no mutable: eval must not mutate
+    assert y.shape == x.shape
+
+
+def test_resnet_generator_shape_block_identity_at_init():
+    x = jnp.asarray(
+        np.random.default_rng(5).uniform(-1, 1, (1, 32, 48, 3)), jnp.float32
+    )
+    net = ResnetGenerator(ngf=8, n_blocks=2, norm="instance")
+    variables = net.init(jax.random.key(0), x, True)
+    y = net.apply(variables, x, True)
+    assert y.shape == (1, 32, 48, 3)
+    assert float(jnp.max(jnp.abs(y))) <= 1.0
+
+
+def test_resnet_block_no_post_add_activation():
+    # classic ResnetBlock: output can go below the pre-add value (no relu
+    # after the residual add, unlike ExpandNetwork's ResidualBlock)
+    from p2p_tpu.models import ResnetBlock
+
+    x = jnp.asarray(
+        np.random.default_rng(6).normal(size=(1, 8, 8, 4)), jnp.float32
+    )
+    blk = ResnetBlock(4, norm="instance")
+    variables = blk.init(jax.random.key(2), x, True)
+    y = blk.apply(variables, x, True)
+    assert float(jnp.min(y)) < 0
+
+
+def test_pix2pixhd_generator_shapes_and_param_split():
+    x = jnp.asarray(
+        np.random.default_rng(7).uniform(-1, 1, (1, 64, 64, 3)), jnp.float32
+    )
+    net = Pix2PixHDGenerator(ngf=8, n_blocks_global=2, n_blocks_local=1,
+                             norm="instance")
+    variables = net.init(jax.random.key(0), x, True)
+    y = net.apply(variables, x, True)
+    assert y.shape == x.shape
+    assert "global" in variables["params"]  # G1 is a named submodule
+    # G1 alone also runs standalone (coarse-to-fine training schedule)
+    g1 = GlobalGenerator(ngf=16, n_blocks=2, norm="instance")
+    v1 = g1.init(jax.random.key(1), x, True)
+    y1 = g1.apply(v1, x, True)
+    assert y1.shape == x.shape
+
+
+def test_registry_builds_all_generator_families():
+    x = jnp.zeros((1, 32, 32, 3))
+    for gen, norm in [("expand", "batch"), ("unet", "batch"),
+                      ("resnet", "instance"), ("pix2pixhd", "instance")]:
+        cfg = ModelConfig(generator=gen, ngf=8, n_blocks=2, norm=norm)
+        g = define_G(cfg)
+        variables = init_variables(g, jax.random.key(0), x, train=True)
+        out = g.apply(variables, x, True, mutable=["batch_stats"])
+        y = out[0] if isinstance(out, tuple) else out
+        assert y.shape == x.shape, gen
+
+
+def test_unet_non_power_of_two_sizes():
+    # 96 = 2^5*3, 48 = 2^4*3 → depth clamps to 4, odd bottleneck survives
+    x = jnp.asarray(
+        np.random.default_rng(8).uniform(-1, 1, (1, 96, 48, 3)), jnp.float32
+    )
+    net = UNetGenerator(ngf=4)
+    variables = net.init(jax.random.key(0), x, True)
+    y, _ = net.apply(variables, x, True, mutable=["batch_stats"])
+    assert y.shape == x.shape
+    downs = [k for k in variables["params"] if k.startswith("down")]
+    assert len(downs) == 4
+
+
+def test_unet_dropout_needs_rng_and_perturbs_output():
+    x = jnp.asarray(
+        np.random.default_rng(9).uniform(-1, 1, (1, 32, 32, 3)), jnp.float32
+    )
+    net = UNetGenerator(ngf=4, use_dropout=True)
+    variables = net.init(jax.random.key(0), x, False)  # eval init: no rng
+    y1, _ = net.apply(variables, x, True, mutable=["batch_stats"],
+                      rngs={"dropout": jax.random.key(1)})
+    y2, _ = net.apply(variables, x, True, mutable=["batch_stats"],
+                      rngs={"dropout": jax.random.key(2)})
+    assert float(jnp.max(jnp.abs(y1 - y2))) > 0
+    # eval path is deterministic without an rng
+    ye = net.apply(variables, x, False)
+    assert ye.shape == x.shape
