@@ -52,8 +52,6 @@ class ModelConfig:
     use_dropout: bool = False
     init_type: str = "normal"   # normal | xavier | kaiming | orthogonal
     init_gain: float = 0.02
-    # vid2vid temporal discriminator window (frames)
-    n_frames: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -223,7 +221,7 @@ _register(
     Config(
         name="vid2vid_temporal",
         model=ModelConfig(generator="unet", ngf=64, norm="instance",
-                          use_compression_net=False, n_frames=8),
+                          use_compression_net=False),
         loss=LossConfig(lambda_feat=10.0, lambda_vgg=0.0, lambda_tv=0.0),
         data=DataConfig(dataset="vid2vid", image_size=256, batch_size=1,
                         n_frames=8),

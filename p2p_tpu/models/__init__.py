@@ -3,6 +3,10 @@ from p2p_tpu.models.expand import ExpandNetwork, ResidualBlock
 from p2p_tpu.models.patchgan import MultiscaleDiscriminator, NLayerDiscriminator
 from p2p_tpu.models.pix2pixhd import GlobalGenerator, Pix2PixHDGenerator
 from p2p_tpu.models.resnet_gen import ResnetBlock, ResnetGenerator
+from p2p_tpu.models.temporal_d import (
+    MultiscaleTemporalDiscriminator,
+    TemporalDiscriminator,
+)
 from p2p_tpu.models.unet import UNetGenerator
 from p2p_tpu.models.vgg import VGG19Features
 from p2p_tpu.models.registry import define_C, define_D, define_G
@@ -18,6 +22,8 @@ __all__ = [
     "ResnetBlock",
     "ResnetGenerator",
     "UNetGenerator",
+    "TemporalDiscriminator",
+    "MultiscaleTemporalDiscriminator",
     "VGG19Features",
     "define_C",
     "define_D",

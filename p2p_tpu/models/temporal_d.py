@@ -1,0 +1,176 @@
+"""Temporal (video) discriminator — the vid2vid capability target
+(BASELINE configs[4]: 8-frame temporal D, sequence-parallel over ICI).
+
+The reference is image-only (SURVEY §5.7: no sequence dimension anywhere);
+this is a new capability, designed TPU-first rather than ported: a 3-D-conv
+PatchGAN over NTHWC clips. Temporal kernels are k_t=3 stride-1 ('same'), so
+under sequence parallelism each conv needs exactly one frame of halo from
+each neighbor — supplied by ``p2p_tpu.parallel.temporal``'s ppermute
+exchange (the conv-GAN equivalent of ring attention's block rotation), or
+inserted automatically by GSPMD when the clip is sharded
+``P('data','time',None,None,None)`` and the apply is jitted over the mesh.
+
+Structure mirrors NLayerDiscriminator (networks.py:758-806) lifted to 3-D:
+stage 0   conv3d(in→ndf, k=(3,4,4), s=(1,2,2)) + LeakyReLU(0.2)
+stages i  conv3d(→min(2^i·ndf,512), k=(3,4,4), s=(1,2,2)) + LReLU
+last      conv3d(→8ndf cap 512, k=(3,4,4), s=(1,1,1)) + LReLU
+head      conv3d(→1, k=(3,4,4), s=1)
+Intermediate activations are returned for temporal feature matching.
+Multiscale: ``num_D`` copies at spatially avg-pooled scales (T untouched).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.models.patchgan import avg_pool_downsample
+from p2p_tpu.ops.conv import normal_init
+from p2p_tpu.ops.spectral_norm import _l2norm, spectral_normalize
+
+
+def avg_pool_spatial_3d(x: jax.Array) -> jax.Array:
+    """AvgPool(3, s2, pad1, count_include_pad=False) over H,W of NTHWC —
+    frames folded into batch so the 2-D helper is the single source of
+    truth."""
+    n, t = x.shape[0], x.shape[1]
+    y = avg_pool_downsample(x.reshape((n * t,) + x.shape[2:]))
+    return y.reshape((n, t) + y.shape[1:])
+
+
+class _Conv3D(nn.Module):
+    features: int
+    stride_hw: int = 2
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(
+            self.features,
+            kernel_size=(3, 4, 4),
+            strides=(1, self.stride_hw, self.stride_hw),
+            padding=((1, 1), (2, 2), (2, 2)),
+            dtype=self.dtype,
+            kernel_init=normal_init(),
+        )(x)
+
+
+class SpectralConv3D(nn.Module):
+    """3-D conv (NTHWC, k=(3,4,4)) with spectral weight norm — the temporal
+    lift of ops.spectral_norm.SpectralConv, sharing its power iteration and
+    'spectral' collection semantics."""
+
+    features: int
+    stride_hw: int = 2
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+    n_power_iterations: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", self.kernel_init, (3, 4, 4, cin, self.features),
+            jnp.float32,
+        )
+        w_mat = kernel.transpose(4, 0, 1, 2, 3).reshape(self.features, -1)
+        u_var = self.variable(
+            "spectral", "u",
+            lambda: _l2norm(
+                jax.random.normal(self.make_rng("params"), (self.features,))
+            ),
+        )
+        sigma, new_u, _ = spectral_normalize(
+            w_mat, u_var.value, self.n_power_iterations
+        )
+        if self.is_mutable_collection("spectral"):
+            u_var.value = new_u
+        kernel_sn = (kernel / sigma).astype(self.dtype or x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x.astype(kernel_sn.dtype),
+            kernel_sn,
+            window_strides=(1, self.stride_hw, self.stride_hw),
+            padding=[(1, 1), (2, 2), (2, 2)],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class TemporalDiscriminator(nn.Module):
+    """Single-scale 3-D PatchGAN on NTHWC clips of (cond ‖ frames).
+
+    ``use_spectral_norm`` puts spectral norm on the inner convs, matching
+    NLayerDiscriminator's placement (first and head convs plain)."""
+
+    ndf: int = 64
+    n_layers: int = 3
+    use_spectral_norm: bool = True
+    get_interm_feat: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x) -> List[jax.Array]:
+        def inner(y, features, stride_hw):
+            if self.use_spectral_norm:
+                return SpectralConv3D(features, stride_hw=stride_hw,
+                                      dtype=self.dtype)(y)
+            return _Conv3D(features, stride_hw=stride_hw, dtype=self.dtype)(y)
+
+        feats = []
+        nf = self.ndf
+        y = _Conv3D(nf, dtype=self.dtype)(x)
+        y = nn.leaky_relu(y, negative_slope=0.2)
+        feats.append(y)
+        for _ in range(1, self.n_layers):
+            nf = min(nf * 2, 512)
+            y = inner(y, nf, 2)
+            y = nn.leaky_relu(y, negative_slope=0.2)
+            feats.append(y)
+        nf = min(nf * 2, 512)
+        y = inner(y, nf, 1)
+        y = nn.leaky_relu(y, negative_slope=0.2)
+        feats.append(y)
+        y = _Conv3D(1, stride_hw=1, dtype=self.dtype)(y)
+        feats.append(y)
+        if self.get_interm_feat:
+            return feats
+        return [feats[-1]]
+
+
+class MultiscaleTemporalDiscriminator(nn.Module):
+    """num_D temporal PatchGANs at spatially downsampled scales (finest
+    first, matching MultiscaleDiscriminator's ordering)."""
+
+    ndf: int = 64
+    n_layers: int = 3
+    num_D: int = 2
+    use_spectral_norm: bool = True
+    get_interm_feat: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x) -> List[List[jax.Array]]:
+        results = []
+        current = x
+        for i in range(self.num_D):
+            d = TemporalDiscriminator(
+                ndf=self.ndf,
+                n_layers=self.n_layers,
+                use_spectral_norm=self.use_spectral_norm,
+                get_interm_feat=self.get_interm_feat,
+                dtype=self.dtype,
+                name=f"tscale{self.num_D - 1 - i}",
+            )
+            results.append(d(current))
+            if i != self.num_D - 1:
+                current = avg_pool_spatial_3d(current)
+        return results

@@ -1,6 +1,12 @@
 from p2p_tpu.train.schedules import lambda_rule, make_schedule, PlateauController
 from p2p_tpu.train.state import TrainState, create_train_state
 from p2p_tpu.train.step import build_eval_step, build_train_step
+from p2p_tpu.train.video_step import (
+    VideoTrainState,
+    build_video_train_step,
+    create_video_train_state,
+    make_parallel_video_step,
+)
 
 __all__ = [
     "lambda_rule",
@@ -10,4 +16,8 @@ __all__ = [
     "create_train_state",
     "build_train_step",
     "build_eval_step",
+    "VideoTrainState",
+    "create_video_train_state",
+    "build_video_train_step",
+    "make_parallel_video_step",
 ]
