@@ -1,0 +1,19 @@
+"""Command-line drivers.
+
+- ``python -m p2p_tpu.cli.train`` — training (reference train.py:133-157
+  flag parity + TPU mesh/preset knobs).
+- ``python -m p2p_tpu.cli.infer`` — batched inference from a checkpoint
+  (replaces reference test.py, which could not load train.py's checkpoints
+  — SURVEY Q5).
+- ``python -m p2p_tpu.cli.generate_dataset`` — offline paired-dataset
+  generation (reference generate_dataset.py:150-165 flag parity).
+"""
+
+import dataclasses
+
+
+def apply_overrides(obj, **kw):
+    """dataclasses.replace with None-valued (unset flag) entries dropped —
+    the shared preset-override rule for every CLI."""
+    kw = {k: v for k, v in kw.items() if v is not None}
+    return dataclasses.replace(obj, **kw) if kw else obj

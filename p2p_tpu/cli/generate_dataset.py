@@ -1,0 +1,56 @@
+"""Offline paired-dataset generation CLI.
+
+Flag parity with reference generate_dataset.py:150-165 (same names):
+--target_dataset_folder / --dataset_path / --bit_size / --max_patches /
+--pool_size / --crop_size / --img_format / --upsampling. The reference's
+commented-out multiprocessing pool (generate_dataset.py:130,139-147) is
+live here via --pool_size workers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from p2p_tpu.data.generate import generate_dataset
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="p2p_tpu dataset generation")
+    p.add_argument("--target_dataset_folder", type=str, required=True,
+                   help="output dataset root (train/{a,b} written under it)")
+    p.add_argument("--dataset_path", type=str, required=True,
+                   help="source image folder")
+    p.add_argument("--split", type=str, default="train", help="train or test")
+    p.add_argument("--bit_size", type=int, default=3,
+                   help="quantizer bit depth for the b/ images")
+    p.add_argument("--max_patches", type=int, default=100)
+    p.add_argument("--pool_size", type=int, default=0,
+                   help="parallel decode workers (0 = inline)")
+    p.add_argument("--crop_size", type=int, default=256,
+                   help="tile size; -1 disables tiling (whole images)")
+    p.add_argument("--img_format", type=str, default="png",
+                   help="accepted for parity; outputs are always png")
+    p.add_argument("--upsampling", type=int, default=0,
+                   help="nearest-upsample every source by this factor (>0)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    n = generate_dataset(
+        src_dir=args.dataset_path,
+        out_dir=args.target_dataset_folder,
+        split=args.split,
+        crop_size=args.crop_size if args.crop_size > 0 else None,
+        max_patches=args.max_patches,
+        bits=args.bit_size,
+        upsample=args.upsampling,
+        workers=args.pool_size,
+    )
+    print(f"wrote {n} paired patches to {args.target_dataset_folder}/{args.split}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

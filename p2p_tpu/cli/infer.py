@@ -1,0 +1,124 @@
+"""Inference CLI — batched generator inference from a training checkpoint.
+
+Replaces the reference's test.py (test.py:1-46), which loads a pickled
+module file train.py never writes (SURVEY Q5). Here inference restores the
+SAME Orbax checkpoint the trainer saves, rebuilds the generator from the
+SAME config preset, and runs the eval path (compression net + quantizer
+when the preset has one, plain G otherwise) over the test split, saving
+predictions to ``result/<dataset>/`` exactly like the reference driver.
+
+Flag parity with test.py (--dataset/--direction/--cuda) plus checkpoint
+addressing by step (--step, default latest).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="p2p_tpu inference")
+    p.add_argument("--preset", type=str, default="reference")
+    p.add_argument("--name", type=str, default=None,
+                   help="training name (checkpoint subdir; default preset name)")
+    p.add_argument("--dataset", type=str, default=None, help="facades")
+    p.add_argument("--direction", type=str, default=None, help="a2b or b2a")
+    p.add_argument("--cuda", action="store_true",
+                   help="accepted for parity; ignored (always TPU/XLA)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step to load (default: latest)")
+    p.add_argument("--data_root", type=str, default=None)
+    p.add_argument("--workdir", type=str, default=".")
+    p.add_argument("--out", type=str, default=None,
+                   help="output dir (default <workdir>/result/<dataset>)")
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--image_size", type=int, default=None)
+    p.add_argument("--ngf", type=int, default=None)
+    p.add_argument("--n_blocks", type=int, default=None)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cuda:
+        print("note: --cuda accepted for parity but ignored (TPU/XLA build)",
+              file=sys.stderr)
+
+    import dataclasses
+
+    import jax
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.pipeline import PairedImageDataset, make_loader
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_eval_step
+    from p2p_tpu.utils.images import save_img
+
+    from p2p_tpu.cli import apply_overrides as over
+
+    cfg = get_preset(args.preset)
+    data = over(cfg.data, dataset=args.dataset, direction=args.direction,
+                test_batch_size=args.batch_size, image_size=args.image_size)
+    model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks)
+    cfg = dataclasses.replace(cfg, data=data, model=model,
+                              name=args.name or cfg.name)
+
+    root = args.data_root or os.path.join(cfg.data.root, cfg.data.dataset)
+    try:
+        ds = PairedImageDataset(
+            root, "test", cfg.data.direction, cfg.data.image_size,
+            cfg.data.image_width,
+        )
+    except (RuntimeError, FileNotFoundError) as e:
+        print(f"no test images under {root}: {e}", file=sys.stderr)
+        return 1
+
+    ckpt_dir = os.path.join(
+        args.workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
+    )
+    ckpt = CheckpointManager(ckpt_dir)
+    step = args.step if args.step is not None else ckpt.latest_step()
+    if step is None:
+        print(f"no checkpoint found under {ckpt_dir}", file=sys.stderr)
+        return 1
+
+    sample = ds[0]
+    bs = cfg.data.test_batch_size
+    sample_batch = {
+        k: np.broadcast_to(v, (bs,) + v.shape).copy() for k, v in sample.items()
+    }
+    state = create_train_state(cfg, jax.random.key(0), sample_batch)
+    state = ckpt.restore(state, step)
+    eval_step = build_eval_step(cfg)
+
+    out_dir = args.out or os.path.join(
+        args.workdir, cfg.train.result_dir, cfg.data.dataset
+    )
+    os.makedirs(out_dir, exist_ok=True)
+
+    n_saved = 0
+    # drop_remainder=False: EVERY test image gets a prediction (the final
+    # partial batch costs one extra compile at its smaller shape)
+    for batch in make_loader(ds, bs, shuffle=False, num_epochs=1,
+                             drop_remainder=False):
+        pred, _ = eval_step(state, batch)
+        pred = np.asarray(pred, np.float32)
+        for i in range(pred.shape[0]):
+            name = ds.names[n_saved] if n_saved < len(ds.names) else f"{n_saved}.png"
+            save_img(pred[i], os.path.join(out_dir, name))
+            n_saved += 1
+            if n_saved >= len(ds):
+                break
+        if n_saved >= len(ds):
+            break
+    print(f"wrote {n_saved} predictions (checkpoint step {step}) to {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

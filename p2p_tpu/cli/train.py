@@ -1,0 +1,128 @@
+"""Training CLI — flag parity with the reference (train.py:133-157) plus
+TPU-native knobs (--preset, --mesh).
+
+Every reference flag is accepted with the same name and default. Flags the
+reference parsed but never used are live here where the intent is clear
+(--lamb wires the pix2pix L1 weight — SURVEY Q3) or accepted-and-ignored
+with a warning where they are meaningless on TPU (--cuda).
+
+Unset flags inherit from the chosen --preset, so
+``--preset pix2pixhd --batch_size 2`` tweaks one knob of a BASELINE config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+from p2p_tpu.core.config import Config, get_preset, list_presets
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="p2p_tpu training")
+    # --- TPU-native knobs -------------------------------------------------
+    p.add_argument("--preset", type=str, default="reference",
+                   help=f"named config preset: {', '.join(list_presets())}")
+    p.add_argument("--data_root", type=str, default=None,
+                   help="dataset root directory (default <root>/<dataset>)")
+    p.add_argument("--workdir", type=str, default=".",
+                   help="checkpoints/results/metrics land here")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="mesh axes 'data,spatial,time' e.g. '4,2,1' "
+                        "(data may be -1 = all remaining devices)")
+    p.add_argument("--image_size", type=int, default=None,
+                   help="override preset image size (height; square unless "
+                        "the preset sets a width)")
+    p.add_argument("--n_blocks", type=int, default=None,
+                   help="override generator residual block count")
+    # --- reference flags (train.py:133-157), same names/defaults ---------
+    p.add_argument("--dataset", type=str, default=None, help="facades")
+    p.add_argument("--name", type=str, default=None, help="training name")
+    p.add_argument("--epoch_count", type=int, default=None)
+    p.add_argument("--nepoch", type=int, default=None)
+    p.add_argument("--niter", type=int, default=None)
+    p.add_argument("--niter_decay", type=int, default=None)
+    p.add_argument("--cuda", action="store_true",
+                   help="accepted for parity; ignored (always TPU/XLA)")
+    p.add_argument("--epochsave", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--test_batch_size", type=int, default=None)
+    p.add_argument("--direction", type=str, default=None, help="a2b or b2a")
+    p.add_argument("--input_nc", type=int, default=None)
+    p.add_argument("--output_nc", type=int, default=None)
+    p.add_argument("--ngf", type=int, default=None)
+    p.add_argument("--ndf", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr_policy", type=str, default=None,
+                   help="lambda|step|plateau|cosine")
+    p.add_argument("--lr_decay_iters", type=int, default=None)
+    p.add_argument("--beta1", type=float, default=None)
+    p.add_argument("--threads", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--lamb", type=float, default=None,
+                   help="L1 weight (dead in the reference — Q3; live here)")
+    return p
+
+
+def config_from_flags(args: argparse.Namespace) -> Config:
+    """Build a Config: preset defaults overridden by explicitly-set flags."""
+    cfg = get_preset(args.preset)
+    model, loss, optim, data, train, par = (
+        cfg.model, cfg.loss, cfg.optim, cfg.data, cfg.train, cfg.parallel
+    )
+    from p2p_tpu.cli import apply_overrides as over
+
+    model = over(model, input_nc=args.input_nc, output_nc=args.output_nc,
+                 ngf=args.ngf, ndf=args.ndf, n_blocks=args.n_blocks)
+    loss = over(loss, lambda_l1=args.lamb)
+    optim = over(optim, lr=args.lr, lr_policy=args.lr_policy,
+                 lr_decay_iters=args.lr_decay_iters, beta1=args.beta1,
+                 niter=args.niter, niter_decay=args.niter_decay)
+    data = over(data, dataset=args.dataset, direction=args.direction,
+                batch_size=args.batch_size, image_size=args.image_size,
+                test_batch_size=args.test_batch_size, threads=args.threads)
+    train = over(train, nepoch=args.nepoch, epoch_count=args.epoch_count,
+                 epoch_save=args.epochsave, seed=args.seed)
+    if args.mesh is not None:
+        from p2p_tpu.core.mesh import MeshSpec
+
+        try:
+            d, s, t = (int(v) for v in args.mesh.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--mesh must be three comma-separated ints "
+                f"'data,spatial,time' (got {args.mesh!r})"
+            )
+        if s < 1 or t < 1 or (d < 1 and d != -1):
+            raise SystemExit(
+                "--mesh axes must be >=1 (data may be -1 = all remaining "
+                f"devices); got {args.mesh!r}"
+            )
+        par = dataclasses.replace(par, mesh=MeshSpec(data=d, spatial=s, time=t))
+    name = args.name or cfg.name
+    return dataclasses.replace(
+        cfg, name=name, model=model, loss=loss, optim=optim, data=data,
+        train=train, parallel=par,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cuda:
+        print("note: --cuda accepted for parity but ignored (TPU/XLA build)",
+              file=sys.stderr)
+    cfg = config_from_flags(args)
+
+    from p2p_tpu.train.loop import Trainer
+
+    trainer = Trainer(cfg, data_root=args.data_root, workdir=args.workdir)
+    resumed = trainer.maybe_resume()
+    if resumed:
+        print(f"resumed at epoch {trainer.epoch}")
+    trainer.fit()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,21 +55,25 @@ def generate_patches(
     src_path: str,
     a_dir: str,
     b_dir: str,
-    crop_size: int = 256,
+    crop_size: Optional[int] = 256,
     max_patches: int = 100,
     bits: int = 3,
-    min_size: Optional[int] = None,
+    upsample: int = 0,
 ) -> int:
     """Tile one source image into paired patches. Returns patches written."""
     img = Image.open(src_path).convert("RGB")
-    if min_size and min(img.size) < min_size:
-        # nearest upsample small sources (generate_dataset.py:60-64)
-        scale = int(np.ceil(min_size / min(img.size)))
+    if upsample > 0:
+        # nearest x|upsample| of EVERY source (generate_dataset.py:60-64)
+        scale = abs(upsample)
         img = img.resize((img.width * scale, img.height * scale), Image.NEAREST)
     arr = np.asarray(img)
-    if arr.shape[0] < crop_size or arr.shape[1] < crop_size:
-        return 0
-    tiles = _tile(arr, crop_size)[:max_patches]
+    if crop_size is None:
+        # whole-image mode (reference --crop_size -1)
+        tiles = [arr]
+    else:
+        if arr.shape[0] < crop_size or arr.shape[1] < crop_size:
+            return 0
+        tiles = _tile(arr, crop_size)[:max_patches]
     stem = os.path.splitext(os.path.basename(src_path))[0]
     for i, patch in enumerate(tiles):
         name = f"{stem}_{i:04d}.png"
@@ -82,10 +86,10 @@ def generate_dataset(
     src_dir: str,
     out_dir: str,
     split: str = "train",
-    crop_size: int = 256,
+    crop_size: Optional[int] = 256,
     max_patches: int = 100,
     bits: int = 3,
-    min_size: Optional[int] = None,
+    upsample: int = 0,
     workers: int = 0,
 ) -> int:
     """Generate <out>/<split>/{a,b}/ from every image under src_dir."""
@@ -98,7 +102,7 @@ def generate_dataset(
     sources = sorted(
         os.path.join(src_dir, f) for f in os.listdir(src_dir) if is_image_file(f)
     )
-    args = [(s, a_dir, b_dir, crop_size, max_patches, bits, min_size) for s in sources]
+    args = [(s, a_dir, b_dir, crop_size, max_patches, bits, upsample) for s in sources]
     if workers and len(sources) > 1:
         with ProcessPoolExecutor(max_workers=workers) as pool:
             counts = list(pool.map(_gen_star, args))

@@ -82,13 +82,17 @@ class PairedImageDataset:
 class _Stacked:
     """Batch a random-access dataset by stacking consecutive items."""
 
-    def __init__(self, ds, batch_size, indices):
+    def __init__(self, ds, batch_size, indices, drop_remainder=True):
         self.ds = ds
         self.bs = batch_size
         self.indices = indices
+        self.drop_remainder = drop_remainder
 
     def __iter__(self):
-        for i in range(0, len(self.indices) - self.bs + 1, self.bs):
+        end = len(self.indices) if not self.drop_remainder else (
+            len(self.indices) - self.bs + 1
+        )
+        for i in range(0, end, self.bs):
             items = [self.ds[j] for j in self.indices[i : i + self.bs]]
             yield {
                 k: np.stack([it[k] for it in items]) for k in items[0]
@@ -116,7 +120,7 @@ def make_loader(
         idx = np.arange(len(dataset))
         if shuffle:
             np.random.default_rng(seed).shuffle(idx)
-        return iter(_Stacked(dataset, batch_size, list(idx)))
+        return iter(_Stacked(dataset, batch_size, list(idx), drop_remainder))
 
     sampler = pg.IndexSampler(
         num_records=len(dataset),

@@ -1,0 +1,159 @@
+"""CLI drivers: flag parity, config mapping, and a tiny end-to-end
+generate→train→infer run through the real entry points."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+from PIL import Image
+
+from p2p_tpu.cli.generate_dataset import main as gen_main
+from p2p_tpu.cli.train import build_parser, config_from_flags
+
+
+def test_config_from_flags_preset_plus_overrides():
+    args = build_parser().parse_args(
+        ["--preset", "reference", "--dataset", "maps", "--batch_size", "4",
+         "--lr", "0.001", "--lamb", "10", "--niter", "5", "--mesh", "2,2,1",
+         "--name", "run1", "--image_size", "64"]
+    )
+    cfg = config_from_flags(args)
+    assert cfg.name == "run1"
+    assert cfg.data.dataset == "maps"
+    assert cfg.data.batch_size == 4
+    assert cfg.data.image_size == 64
+    assert cfg.optim.lr == 0.001
+    assert cfg.optim.niter == 5
+    assert cfg.loss.lambda_l1 == 10.0       # Q3: --lamb is live here
+    assert cfg.parallel.mesh.data == 2 and cfg.parallel.mesh.spatial == 2
+    # untouched knobs inherit the preset
+    assert cfg.model.use_compression_net
+    assert cfg.loss.lambda_vgg == 10.0
+
+
+def test_config_from_flags_defaults_match_reference():
+    cfg = config_from_flags(build_parser().parse_args([]))
+    # reference train.py defaults: lr=2e-4, beta1=0.5, lambda policy
+    assert cfg.optim.lr == 2e-4
+    assert cfg.optim.beta1 == 0.5
+    assert cfg.optim.lr_policy == "lambda"
+    assert cfg.data.direction == "b2a"
+
+
+def _write_sources(src, n=3, size=64):
+    os.makedirs(src, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        arr = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(os.path.join(src, f"s{i}.png"))
+
+
+def test_generate_dataset_cli(tmp_path):
+    src = str(tmp_path / "src")
+    out = str(tmp_path / "ds")
+    _write_sources(src)
+    rc = gen_main([
+        "--target_dataset_folder", out, "--dataset_path", src,
+        "--crop_size", "32", "--bit_size", "3", "--max_patches", "2",
+    ])
+    assert rc == 0
+    a = sorted(os.listdir(os.path.join(out, "train", "a")))
+    b = sorted(os.listdir(os.path.join(out, "train", "b")))
+    assert a == b and len(a) == 6  # 3 sources x 2 patches
+    # b/ is the quantized copy: fewer distinct levels per channel
+    arr_b = np.asarray(Image.open(os.path.join(out, "train", "b", b[0])))
+    assert len(np.unique(arr_b)) <= 8 * 3
+
+
+def test_generate_dataset_cli_whole_image(tmp_path):
+    src = str(tmp_path / "src")
+    out = str(tmp_path / "ds")
+    _write_sources(src, n=2, size=48)
+    rc = gen_main([
+        "--target_dataset_folder", out, "--dataset_path", src,
+        "--crop_size", "-1",
+    ])
+    assert rc == 0
+    a = os.listdir(os.path.join(out, "train", "a"))
+    assert len(a) == 2
+    arr = np.asarray(Image.open(os.path.join(out, "train", "a", a[0])))
+    assert arr.shape == (48, 48, 3)  # whole image, untiled
+
+
+def test_train_and_infer_cli_end_to_end(tmp_path):
+    """generate → 1-epoch train → infer, all through python -m entry points
+    (subprocess so each gets the CPU-platform env cleanly)."""
+    src = str(tmp_path / "src")
+    _write_sources(src, n=4, size=32)
+    ds = str(tmp_path / "ds" / "facades")
+    for split in ("train", "test"):
+        rc = gen_main([
+            "--target_dataset_folder", ds, "--dataset_path", src,
+            "--split", split, "--crop_size", "32",
+        ])
+        assert rc == 0
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+    common = ["--preset", "reference", "--dataset", "facades", "--name",
+              "t", "--image_size", "32", "--ngf", "4", "--n_blocks", "1",
+              "--data_root", ds]
+    r = subprocess.run(
+        [sys.executable, "-m", "p2p_tpu.cli.train", *common,
+         "--nepoch", "1", "--epochsave", "1", "--batch_size", "2",
+         "--threads", "0"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.isdir(tmp_path / "checkpoint" / "facades" / "t")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "p2p_tpu.cli.infer", *common,
+         "--out", str(tmp_path / "pred")],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    preds = os.listdir(tmp_path / "pred")
+    assert len(preds) == 4
+
+
+def test_mesh_flag_errors_are_clean():
+    import pytest
+
+    with pytest.raises(SystemExit):
+        config_from_flags(build_parser().parse_args(["--mesh", "4,2"]))
+    with pytest.raises(SystemExit):
+        config_from_flags(build_parser().parse_args(["--mesh", "4x2x1"]))
+    with pytest.raises(SystemExit):
+        config_from_flags(build_parser().parse_args(["--mesh", "4,-1,1"]))
+
+
+def test_generate_dataset_upsampling_is_scale_factor(tmp_path):
+    # reference semantics: --upsampling N nearest-upsamples EVERY source xN
+    src = str(tmp_path / "src")
+    out = str(tmp_path / "ds")
+    _write_sources(src, n=1, size=24)
+    rc = gen_main([
+        "--target_dataset_folder", out, "--dataset_path", src,
+        "--crop_size", "-1", "--upsampling", "2",
+    ])
+    assert rc == 0
+    a = os.listdir(os.path.join(out, "train", "a"))
+    arr = np.asarray(Image.open(os.path.join(out, "train", "a", a[0])))
+    assert arr.shape == (48, 48, 3)
+
+
+def test_loader_keeps_tail_batch_when_asked():
+    from p2p_tpu.data.pipeline import make_loader
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.data.pipeline import PairedImageDataset
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        make_synthetic_dataset(d, n_train=0, n_test=5, size=16)
+        ds = PairedImageDataset(d, "test", image_size=16)
+        kept = list(make_loader(ds, 3, shuffle=False, num_epochs=1,
+                                drop_remainder=False))
+        dropped = list(make_loader(ds, 3, shuffle=False, num_epochs=1))
+        assert sum(b["input"].shape[0] for b in kept) == 5
+        assert sum(b["input"].shape[0] for b in dropped) == 3
