@@ -1,19 +1,28 @@
-"""Benchmark: training throughput (img/sec/chip) on the flagship config.
+"""Benchmark: training throughput (img/sec/chip) vs the north star
+(BASELINE.json: >= 2000 img/s/chip @ 256^2 pix2pix on TPU).
 
-Runs the full jitted alternating-GAN train step (G+D+C updates, LSGAN +
-feature-matching + VGG19-perceptual + TV losses, STE quantizer, spectral
-norm) on 256x256 synthetic pairs — the reference's workload (train.py hot
-loop, SURVEY §3.1) at the north-star metric: images/sec/chip vs the
-BASELINE.json target of 2000 img/s/chip on TPU.
+Headline metric: the full jitted pix2pix train step (U-Net G + 70x70
+PatchGAN D + L1, the 'facades'/'edges2shoes' preset family) on 256x256
+synthetic pairs. BENCH_PRESET selects any other preset (e.g. 'reference'
+for the heavy ExpandNetwork + multiscale-D + VGG workload).
+
+Timing methodology (tunneled-TPU safe): K train steps run inside ONE
+jitted ``lax.scan`` dispatch (build_multi_train_step) so per-call host/
+tunnel overhead amortizes away; calls are CHAINED (each consumes the
+previous state) and a single host fetch of the final loss forces the whole
+chain — ``jax.block_until_ready`` does not reliably fence on the tunneled
+'axon' platform, and per-step fetches would bill one tunnel round-trip per
+step. The RTT of a trivial fetch is measured separately and subtracted.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Env knobs: BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG (image size).
+Env knobs: BENCH_PRESET, BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import time
@@ -28,46 +37,66 @@ def main() -> None:
     from p2p_tpu.data.synthetic import synthetic_batch
     from p2p_tpu.models.vgg import load_vgg19_params
     from p2p_tpu.train.state import create_train_state
-    from p2p_tpu.train.step import build_train_step
+    from p2p_tpu.train.step import build_multi_train_step
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    preset = os.environ.get("BENCH_PRESET", "facades")
     img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
-    bs = int(os.environ.get("BENCH_BS", "8" if on_tpu else "2"))
-    n_steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
-    warmup = max(2, n_steps // 10)
+    bs = int(os.environ.get("BENCH_BS", "32" if on_tpu else "2"))
+    scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "2"))
+    n_calls = int(os.environ.get("BENCH_STEPS", "64" if on_tpu else "4")) // scan_k
+    n_calls = max(n_calls, 2)
 
-    import dataclasses
-
-    cfg = get_preset("reference")
+    cfg = get_preset(preset)
     cfg = cfg.replace(
-        data=dataclasses.replace(cfg.data, batch_size=bs, image_size=img)
+        data=dataclasses.replace(
+            cfg.data, batch_size=bs, image_size=img, image_width=None
+        )
     )
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
     host = synthetic_batch(batch_size=bs, size=img, bits=cfg.model.quant_bits)
-    batch = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
+    single = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
+    batches = {
+        k: jnp.asarray(np.broadcast_to(v, (scan_k,) + v.shape).copy(),
+                       jnp.float32)
+        for k, v in host.items()
+    }
 
-    state = create_train_state(cfg, jax.random.key(0), batch, train_dtype=dtype)
-    vgg_params = load_vgg19_params(jnp.bfloat16 if dtype is not None else jnp.float32)
-    step = build_train_step(cfg, vgg_params, train_dtype=dtype)
+    state = create_train_state(cfg, jax.random.key(0), single,
+                               train_dtype=dtype)
+    vgg_params = None
+    if cfg.loss.lambda_vgg > 0:
+        vgg_params = load_vgg19_params(
+            jnp.bfloat16 if dtype is not None else jnp.float32
+        )
+    step = build_multi_train_step(cfg, vgg_params, train_dtype=dtype)
 
-    for _ in range(warmup):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics)
+    # tunnel round-trip cost of one trivial fetch
+    trivial = jax.jit(lambda v: v + 1)
+    float(trivial(jnp.ones(())))
+    t0 = time.perf_counter()
+    float(trivial(jnp.ones(())))
+    rtt = time.perf_counter() - t0
+
+    # warmup (compile) + fence
+    state, metrics = step(state, batches)
+    float(metrics["loss_g"][-1])
 
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics)
-    elapsed = time.perf_counter() - t0
+    for _ in range(n_calls):
+        state, metrics = step(state, batches)
+    float(metrics["loss_g"][-1])  # forces the whole chained sequence
+    elapsed = max(time.perf_counter() - t0 - rtt, 1e-9)
 
-    img_per_sec = bs * n_steps / elapsed
-    baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 on TPU
-    # only a real-TPU 256^2 run is comparable to the baseline number
-    comparable = on_tpu and img == 256
+    img_per_sec = bs * scan_k * n_calls / elapsed
+    baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 pix2pix
+    comparable = on_tpu and img == 256 and preset in (
+        "facades", "edges2shoes_dp"
+    )
     print(json.dumps({
-        "metric": f"train_throughput_{platform}_{img}px_bs{bs}",
+        "metric": f"train_throughput_{preset}_{platform}_{img}px_bs{bs}",
         "value": round(img_per_sec, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(img_per_sec / baseline, 4) if comparable else 0.0,

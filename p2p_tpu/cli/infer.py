@@ -39,6 +39,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image_size", type=int, default=None)
     p.add_argument("--ngf", type=int, default=None)
     p.add_argument("--n_blocks", type=int, default=None)
+    p.add_argument("--upsample_mode", type=str, default=None,
+                   choices=["deconv", "resize"])
     return p
 
 
@@ -64,7 +66,8 @@ def main(argv=None) -> int:
     cfg = get_preset(args.preset)
     data = over(cfg.data, dataset=args.dataset, direction=args.direction,
                 test_batch_size=args.batch_size, image_size=args.image_size)
-    model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks)
+    model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks,
+                 upsample_mode=args.upsample_mode)
     cfg = dataclasses.replace(cfg, data=data, model=model,
                               name=args.name or cfg.name)
 

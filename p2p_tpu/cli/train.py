@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "the preset sets a width)")
     p.add_argument("--n_blocks", type=int, default=None,
                    help="override generator residual block count")
+    p.add_argument("--upsample_mode", type=str, default=None,
+                   choices=["deconv", "resize"],
+                   help="U-Net decoder upsampling (deconv = torch-parity "
+                        "ConvTranspose; resize = nearest+conv)")
     # --- reference flags (train.py:133-157), same names/defaults ---------
     p.add_argument("--dataset", type=str, default=None, help="facades")
     p.add_argument("--name", type=str, default=None, help="training name")
@@ -74,7 +78,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     from p2p_tpu.cli import apply_overrides as over
 
     model = over(model, input_nc=args.input_nc, output_nc=args.output_nc,
-                 ngf=args.ngf, ndf=args.ndf, n_blocks=args.n_blocks)
+                 ngf=args.ngf, ndf=args.ndf, n_blocks=args.n_blocks,
+                 upsample_mode=args.upsample_mode)
     loss = over(loss, lambda_l1=args.lamb)
     optim = over(optim, lr=args.lr, lr_policy=args.lr_policy,
                  lr_decay_iters=args.lr_decay_iters, beta1=args.beta1,

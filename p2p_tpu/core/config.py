@@ -50,6 +50,9 @@ class ModelConfig:
     # U-Net decoder dropout (the pix2pix noise source). The train step
     # threads a per-step dropout rng when this is on.
     use_dropout: bool = False
+    # U-Net decoder upsampling: "deconv" (ConvTranspose k4 s2 — torch
+    # parity, ~2x fewer decoder FLOPs) or "resize" (nearest + conv k3).
+    upsample_mode: str = "deconv"
     init_type: str = "normal"   # normal | xavier | kaiming | orthogonal
     init_gain: float = 0.02
 
@@ -116,6 +119,11 @@ class TrainConfig:
     result_dir: str = "result"
     eval_every_epoch: bool = True
     mixed_precision: bool = True
+    # VFID (Fréchet distance over pooled VGG19 taps) during eval — the
+    # north-star quality metric; needs lambda_vgg>0 or a VGG asset loaded.
+    eval_fid: bool = False
+    # jax_debug_nans: first NaN-producing primitive raises with location.
+    debug_nans: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

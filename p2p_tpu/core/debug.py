@@ -1,0 +1,33 @@
+"""Numerical-debug guards (SURVEY §5.2: the reference's only failure mode
+is numerical — Inf-PSNR clamping at train.py:480-482, isnan import at
+train.py:6 — and JAX's functional purity removes the race-condition class
+entirely, so this is the sanitizer surface).
+
+- :func:`enable_nan_debugging` — turn on ``jax_debug_nans`` so the first
+  NaN-producing primitive raises with its location (re-runs the op
+  un-jitted; debugging tool, not a production guard).
+- :func:`check_finite` — host-side pytree guard for post-step use.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def enable_nan_debugging(enable: bool = True) -> None:
+    jax.config.update("jax_debug_nans", enable)
+
+
+def check_finite(tree: Any, name: str = "tree") -> None:
+    """Raise FloatingPointError naming the first non-finite leaf."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.all(np.isfinite(arr)):
+            keys = "/".join(str(getattr(p, "key", p)) for p in path)
+            raise FloatingPointError(
+                f"non-finite values in {name}:{keys} "
+                f"(nan={int(np.isnan(arr).sum())}, inf={int(np.isinf(arr).sum())})"
+            )

@@ -142,11 +142,15 @@ def device_prefetch(
     iterator: Iterator,
     sharding=None,
     buffer_size: int = 2,
+    with_aux: bool = False,
 ):
     """Double-buffered host→device transfer.
 
     Eagerly enqueues ``buffer_size`` batches with ``jax.device_put`` (async
     on TPU) so step N+1's H2D copy overlaps step N's compute.
+
+    ``with_aux``: the iterator yields ``(batch, aux)`` pairs; the batch is
+    device-put, the aux rides along untouched.
     """
     queue = collections.deque()
 
@@ -157,8 +161,12 @@ def device_prefetch(
             lambda x: jax.device_put(x, sharding), batch
         )
 
-    for batch in iterator:
-        queue.append(_put(batch))
+    for item in iterator:
+        if with_aux:
+            batch, aux = item
+            queue.append((_put(batch), aux))
+        else:
+            queue.append(_put(item))
         if len(queue) >= buffer_size:
             yield queue.popleft()
     while queue:

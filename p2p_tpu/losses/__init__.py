@@ -2,7 +2,13 @@ from p2p_tpu.losses.gan import gan_loss
 from p2p_tpu.losses.feature_matching import feature_matching_loss
 from p2p_tpu.losses.perceptual import VGG_SLICE_WEIGHTS, vgg_loss
 from p2p_tpu.losses.metrics import psnr, ssim
-from p2p_tpu.losses.fid import frechet_distance, gaussian_stats
+from p2p_tpu.losses.fid import (
+    FIDEvaluator,
+    frechet_distance,
+    gaussian_stats,
+    make_vgg_feature_fn,
+)
+from p2p_tpu.losses.style import gram_matrix, style_loss
 
 __all__ = [
     "gan_loss",
@@ -13,4 +19,8 @@ __all__ = [
     "ssim",
     "frechet_distance",
     "gaussian_stats",
+    "FIDEvaluator",
+    "make_vgg_feature_fn",
+    "gram_matrix",
+    "style_loss",
 ]

@@ -71,3 +71,42 @@ def frechet_distance(mu1, cov1, mu2, cov2, eps: float = 1e-6) -> float:
     tr_sqrt = np.sqrt(np.clip(vals, 0, None)).sum()
     d2 = diff @ diff + np.trace(cov1) + np.trace(cov2) - 2.0 * tr_sqrt
     return float(max(d2, 0.0))  # eps regularization can leave tiny negatives
+
+
+def make_vgg_feature_fn(vgg_params, imagenet_norm: bool = False):
+    """Jitted ``images → (N, D)`` feature embedding for VFID: the five VGG19
+    tap activations spatially mean-pooled and concatenated (D = 1472)."""
+    from p2p_tpu.models.vgg import VGG19Features
+
+    model = VGG19Features(imagenet_norm=imagenet_norm)
+
+    @jax.jit
+    def fn(images):
+        feats = model.apply({"params": vgg_params}, images)
+        pooled = [jnp.mean(f.astype(jnp.float32), axis=(1, 2)) for f in feats]
+        return jnp.concatenate(pooled, axis=-1)
+
+    return fn
+
+
+class FIDEvaluator:
+    """Accumulate real/fake feature stats batch-by-batch, then distance.
+
+    >>> ev = FIDEvaluator(make_vgg_feature_fn(vgg_params))
+    >>> for batch: ev.update(real_images, fake_images)
+    >>> ev.compute()
+    """
+
+    def __init__(self, feature_fn, dim: int = 1472):
+        self.feature_fn = feature_fn
+        self.real = RunningStats(dim)
+        self.fake = RunningStats(dim)
+
+    def update(self, real_images, fake_images) -> None:
+        self.real.update(self.feature_fn(real_images))
+        self.fake.update(self.feature_fn(fake_images))
+
+    def compute(self) -> float:
+        mu_r, cov_r = self.real.finalize()
+        mu_f, cov_f = self.fake.finalize()
+        return frechet_distance(mu_r, cov_r, mu_f, cov_f)

@@ -1,0 +1,47 @@
+"""Gram-matrix style loss.
+
+The reference carries this as a dead experiment (``gram`` /
+``calc_Gram_Loss`` at train.py:67-101, call sites commented at
+train.py:370-382); it is live here as an optional loss term for style-
+transfer-flavored configs.
+
+Gram of NHWC features: per-image G = FᵀF / (H·W·C) over the flattened
+spatial dims (the reference normalizes by h*w*ch — train.py:84-90).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from p2p_tpu.losses.perceptual import VGG_SLICE_WEIGHTS
+from p2p_tpu.models.vgg import VGG19Features
+
+
+def gram_matrix(feats: jax.Array) -> jax.Array:
+    """(N, H, W, C) → (N, C, C) normalized Gram matrices."""
+    n, h, w, c = feats.shape
+    f = feats.astype(jnp.float32).reshape(n, h * w, c)
+    return jnp.einsum("nsc,nsd->ncd", f, f) / float(h * w * c)
+
+
+def style_loss(
+    vgg_params: Any,
+    fake: jax.Array,
+    real: jax.Array,
+    imagenet_norm: bool = False,
+    weights: Optional[List[float]] = None,
+) -> jax.Array:
+    """Σ_i w_i · L1(Gram(VGG_i(fake)), Gram(VGG_i(real)))."""
+    model = VGG19Features(imagenet_norm=imagenet_norm)
+    f_feats = model.apply({"params": vgg_params}, fake)
+    r_feats = model.apply({"params": vgg_params}, real)
+    w = weights or VGG_SLICE_WEIGHTS
+    total = jnp.zeros((), jnp.float32)
+    for wi, ff, rf in zip(w, f_feats, r_feats):
+        gf = gram_matrix(ff)
+        gr = jax.lax.stop_gradient(gram_matrix(rf))
+        total = total + wi * jnp.mean(jnp.abs(gf - gr))
+    return total

@@ -41,7 +41,8 @@ def define_G(cfg: ModelConfig, dtype=None, remat: bool = False) -> nn.Module:
 
         return UNetGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm,
-            use_dropout=cfg.use_dropout, dtype=dtype,
+            use_dropout=cfg.use_dropout, upsample_mode=cfg.upsample_mode,
+            dtype=dtype,
         )
     if cfg.generator == "resnet":
         from p2p_tpu.models.resnet_gen import ResnetGenerator

@@ -94,9 +94,33 @@ class Trainer:
 
             dtype = jnp.bfloat16
 
+        if cfg.train.debug_nans:
+            from p2p_tpu.core.debug import enable_nan_debugging
+
+            enable_nan_debugging()
+
         self.vgg_params = (
-            load_vgg19_params() if cfg.loss.lambda_vgg > 0 else None
+            load_vgg19_params()
+            if (cfg.loss.lambda_vgg > 0 or cfg.train.eval_fid) else None
         )
+        self.fid_feature_fn = None
+        self.vgg_source = None
+        if cfg.train.eval_fid and self.vgg_params is not None:
+            from p2p_tpu.losses.fid import make_vgg_feature_fn
+            from p2p_tpu.models.vgg import vgg19_params_source
+
+            self.vgg_source = vgg19_params_source()
+            if self.vgg_source != "pretrained":
+                print(
+                    "WARNING: VFID will use RANDOM VGG19 features (no "
+                    "pretrained npz asset found) — distances are not "
+                    "comparable to real VFID/FID numbers.",
+                    flush=True,
+                )
+            # built once: jit cache survives across epochs
+            self.fid_feature_fn = make_vgg_feature_fn(
+                self.vgg_params, cfg.loss.vgg_imagenet_norm
+            )
         sample = self._host_batch_sample()
         self.state = create_train_state(
             cfg, jax.random.key(cfg.train.seed), sample,
@@ -150,12 +174,17 @@ class Trainer:
         # step regardless of log_every.
         sums: Optional[Dict[str, jax.Array]] = None
         count = 0
+        t0 = time.perf_counter()
         for batch in device_prefetch(loader, self.batch_sharding):
             self.state, metrics = self.train_step(self.state, batch)
             sums = metrics if sums is None else jax.tree_util.tree_map(
                 jax.numpy.add, sums, metrics
             )
             count += 1
+            if count == 1:
+                # the first call blocks on trace+XLA compile; exclude it
+                # from the throughput figure (first epoch only, in practice)
+                t0 = time.perf_counter()
             if count % cfg.train.log_every == 0:
                 host = {k: float(v) for k, v in metrics.items()}
                 self.logger.log(
@@ -164,24 +193,55 @@ class Trainer:
                 )
         if sums is None:
             return {}
-        host_sums = jax.device_get(sums)
-        return {k: float(v) / count for k, v in host_sums.items()}
+        host_sums = jax.device_get(sums)  # fences the epoch's last step
+        elapsed = time.perf_counter() - t0
+        out = {k: float(v) / count for k, v in host_sums.items()}
+        if count > 1:
+            out["img_per_sec"] = (
+                (count - 1) * cfg.data.batch_size / max(elapsed, 1e-9)
+            )
+        return out
 
     def evaluate(self, save_samples: bool = False) -> Dict[str, float]:
         cfg = self.cfg
         loader = make_loader(
             self.test_ds, cfg.data.test_batch_size, shuffle=False,
-            num_epochs=1,
+            num_epochs=1, drop_remainder=False,
         )
         psnrs: List[float] = []
         ssims: List[float] = []
+        fid_eval = None
+        if self.fid_feature_fn is not None:
+            from p2p_tpu.losses.fid import FIDEvaluator
+
+            fid_eval = FIDEvaluator(self.fid_feature_fn)
+        # partial tail batches (drop_remainder=False: EVERY test image is
+        # scored) must still split over the mesh's data axis — pad by
+        # edge-repeat, then trim the per-image metric vectors.
+        shards = int(self.mesh.shape["data"]) if self.mesh is not None else 1
+
+        def padded(it):
+            for b in it:
+                n = b["input"].shape[0]
+                pad = (-n) % shards
+                if pad:
+                    b = {
+                        k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                        for k, v in b.items()
+                    }
+                yield b, n
+
         sample_saved = False
-        for batch in device_prefetch(loader, self.batch_sharding):
+        for batch, n_real in device_prefetch(
+            padded(loader), self.batch_sharding, with_aux=True
+        ):
             pred, metrics = self.eval_step(self.state, batch)
+            if fid_eval is not None:
+                fid_eval.update(batch["target"][:n_real], pred[:n_real])
             # per-image vectors → the max below is over individual images,
             # matching the reference report (train.py:498-502)
-            psnrs.extend(np.asarray(metrics["psnr"]).ravel().tolist())
-            ssims.extend(np.asarray(metrics["ssim"]).ravel().tolist())
+            psnrs.extend(np.asarray(metrics["psnr"]).ravel()[:n_real].tolist())
+            ssims.extend(np.asarray(metrics["ssim"]).ravel()[:n_real].tolist())
             if save_samples and not sample_saved:
                 out_dir = os.path.join(
                     self.workdir, cfg.train.result_dir, cfg.data.dataset
@@ -199,7 +259,12 @@ class Trainer:
             "psnr_max": float(np.max(psnrs)),
             "ssim_mean": float(np.mean(ssims)),
             "ssim_max": float(np.max(ssims)),
+            "n_images": len(psnrs),
         }
+        if fid_eval is not None and fid_eval.real.n > 1:
+            result["vfid"] = fid_eval.compute()
+            if self.vgg_source != "pretrained":
+                result["vfid_feature_source"] = self.vgg_source
         self.logger.log({"kind": "eval", "epoch": self.epoch, **result})
         return result
 

@@ -239,6 +239,33 @@ def build_train_step(
     return step
 
 
+def build_multi_train_step(
+    cfg: Config,
+    vgg_params: Optional[Any] = None,
+    steps_per_epoch: int = 1,
+    train_dtype=None,
+    unroll: int = 1,
+):
+    """``multi_step(state, batches) -> (state, metrics)`` scanning K train
+    steps in ONE dispatch.
+
+    ``batches`` is the single-step batch dict with a leading scan axis:
+    ``{"input": (K, N, H, W, C), "target": (K, N, H, W, C)}``. Metrics are
+    per-step stacked (K,). One XLA program per K steps amortizes host
+    dispatch — on a tunneled TPU the per-call overhead is comparable to the
+    step itself, so this is the difference between ~60% and ~95% device
+    utilization in the inner loop.
+    """
+    inner = build_train_step(
+        cfg, vgg_params, steps_per_epoch, train_dtype, jit=False
+    )
+
+    def multi_step(state: TrainState, batches: Dict[str, jax.Array]):
+        return jax.lax.scan(inner, state, batches, unroll=unroll)
+
+    return jax.jit(multi_step, donate_argnums=0)
+
+
 def build_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
     """``eval_step(state, batch) -> (prediction, metrics)``.
 

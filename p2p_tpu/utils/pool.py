@@ -1,0 +1,45 @@
+"""ImagePool — historical-fake buffer (the CycleGAN trick).
+
+Behavior parity with /root/reference/networks.py:64-91: ``pool_size == 0``
+is a pure passthrough (exactly how the reference instantiates it —
+ImagePool(0) at train.py:248); otherwise each incoming fake fills the
+buffer until full, then with probability 0.5 it swaps with a random stored
+image (return the stored one, keep the new one) and with 0.5 passes
+through.
+
+Host-side by design: the pool is a training-data perturbation, not part of
+the differentiated graph — keep it out of jit and feed its output as the
+batch's fake image. NumPy arrays in, NumPy arrays out.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ImagePool:
+    def __init__(self, pool_size: int, seed: int = 0):
+        self.pool_size = pool_size
+        self.images: list = []
+        self.rng = np.random.default_rng(seed)
+
+    def query(self, images: np.ndarray) -> np.ndarray:
+        """images: (N, H, W, C) batch of fakes → same-shape batch drawn per
+        the reference's 50% swap rule."""
+        if self.pool_size == 0:
+            return images
+        out = []
+        for img in np.asarray(images):
+            if len(self.images) < self.pool_size:
+                self.images.append(img.copy())
+                out.append(img)
+            elif self.rng.random() > 0.5:
+                idx = int(self.rng.integers(0, self.pool_size))
+                stored = self.images[idx]
+                self.images[idx] = img.copy()
+                out.append(stored)
+            else:
+                out.append(img)
+        return np.stack(out)

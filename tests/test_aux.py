@@ -1,0 +1,106 @@
+"""Auxiliary subsystems: ImagePool, style loss, profiling, NaN guard, FID
+evaluator (SURVEY §5 capability surface)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_tpu.core.debug import check_finite
+from p2p_tpu.losses import (
+    FIDEvaluator,
+    gram_matrix,
+    make_vgg_feature_fn,
+    style_loss,
+)
+from p2p_tpu.models.vgg import load_vgg19_params
+from p2p_tpu.utils import ImagePool, StepTimer
+
+
+def test_image_pool_zero_is_passthrough():
+    pool = ImagePool(0)
+    x = np.random.default_rng(0).normal(size=(4, 8, 8, 3)).astype(np.float32)
+    np.testing.assert_array_equal(pool.query(x), x)
+    assert pool.images == []
+
+
+def test_image_pool_fills_then_swaps():
+    pool = ImagePool(4, seed=1)
+    rng = np.random.default_rng(0)
+    first = rng.normal(size=(4, 4, 4, 3)).astype(np.float32)
+    out = pool.query(first)
+    np.testing.assert_array_equal(out, first)     # filling phase: passthrough
+    assert len(pool.images) == 4
+    # past capacity: ~half the returns come from the buffer
+    swapped = 0
+    for _ in range(50):
+        batch = rng.normal(size=(4, 4, 4, 3)).astype(np.float32)
+        out = pool.query(batch)
+        swapped += int((~np.isclose(out, batch).all(axis=(1, 2, 3))).sum())
+        assert len(pool.images) == 4
+    assert 40 < swapped < 160  # E=100 at p=0.5 over 200 queries
+
+
+def test_gram_matrix_properties():
+    f = jnp.asarray(
+        np.random.default_rng(2).normal(size=(2, 4, 4, 3)), jnp.float32
+    )
+    g = gram_matrix(f)
+    assert g.shape == (2, 3, 3)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g).transpose(0, 2, 1),
+                               rtol=1e-4)   # symmetric
+    # matches the reference formula f.view(n, -1) @ f.T / (h*w*c)
+    fn = np.asarray(f).reshape(2, 16, 3)
+    expect = np.einsum("nsc,nsd->ncd", fn, fn) / (4 * 4 * 3)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-4, atol=1e-6)
+
+
+def test_style_loss_zero_for_identical_positive_otherwise():
+    params = load_vgg19_params()
+    x = jnp.asarray(
+        np.random.default_rng(3).uniform(-1, 1, (1, 32, 32, 3)), jnp.float32
+    )
+    y = jnp.asarray(
+        np.random.default_rng(4).uniform(-1, 1, (1, 32, 32, 3)), jnp.float32
+    )
+    assert float(style_loss(params, x, x)) == pytest.approx(0.0, abs=1e-6)
+    assert float(style_loss(params, x, y)) > 0
+
+
+def test_fid_evaluator_discriminates():
+    params = load_vgg19_params()
+    fn = make_vgg_feature_fn(params)
+    rng = np.random.default_rng(5)
+    real = rng.uniform(-1, 1, (16, 32, 32, 3)).astype(np.float32)
+
+    ev_same = FIDEvaluator(fn)
+    ev_diff = FIDEvaluator(fn)
+    for i in range(0, 16, 4):
+        batch = real[i : i + 4]
+        ev_same.update(batch, batch + 0.01 * rng.normal(size=batch.shape))
+        ev_diff.update(batch, np.clip(batch + 0.8 * rng.normal(size=batch.shape), -1, 1))
+    close = ev_same.compute()
+    far = ev_diff.compute()
+    assert close < far
+    assert close >= 0
+
+
+def test_step_timer_throughput():
+    t = StepTimer(batch_size=10, skip_first=1)
+    import time
+
+    for _ in range(4):
+        t.tick()
+        time.sleep(0.01)
+    t.tick()
+    # 4 intervals seen, first discarded → 3 timed at ~10ms each
+    assert t.intervals == 3
+    assert 100 < t.images_per_sec < 5000
+
+
+def test_check_finite_names_the_leaf():
+    good = {"a": jnp.ones((2,)), "b": {"c": jnp.zeros((3,))}}
+    check_finite(good)
+    bad = {"a": jnp.ones((2,)), "b": {"c": jnp.asarray([1.0, np.nan, np.inf])}}
+    with pytest.raises(FloatingPointError, match="b/c"):
+        check_finite(bad, "state")

@@ -49,3 +49,28 @@ def test_trainer_end_to_end(tmp_path):
     assert tr2.maybe_resume()
     assert int(tr2.state.step) == int(tr.state.step)
     assert tr2.epoch == 3
+
+
+def test_evaluate_scores_every_test_image(tmp_path):
+    """drop_remainder=False + tail padding: a 5-image test split at
+    test_batch_size=2 scores exactly 5 images."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=2, n_test=5, size=16)
+    cfg = get_preset("reference")
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, ngf=4, n_blocks=1),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=16,
+                                 test_batch_size=2),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+        loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+    )
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path))
+    result = tr.evaluate()
+    assert np.isfinite(result["psnr_mean"])
+    assert result["n_images"] == 5  # tail batch scored, padding trimmed
