@@ -64,6 +64,9 @@ class LossConfig:
     lambda_vgg: float = 10.0         # train.py:377
     lambda_tv: float = 1.0           # train.py:378
     lambda_l1: float = 0.0           # reference --lamb=10 but L1 is dead (Q3)
+    # Gram-matrix style loss — the reference's commented-out experiment
+    # (train.py:370-382), live behind this weight.
+    lambda_style: float = 0.0
     # Feed [-1,1] images to VGG un-normalized, as the reference does
     # (networks.py:26 — no ImageNet mean/std). Changes loss scale; keep
     # faithful by default.

@@ -57,6 +57,14 @@ class PairedImageDataset:
         return len(self.names)
 
     def _load(self, path: str) -> np.ndarray:
+        # native C++ decode+normalize fast path (p2p_tpu.native) when the
+        # file is a PNG already at target size (checked via a header probe
+        # before any inflate work); PIL otherwise
+        from p2p_tpu import native
+
+        fast = native.load_image_fast(path, expect_hw=(self.h, self.w))
+        if fast is not None:
+            return fast[1]
         img = Image.open(path).convert("RGB")
         if img.size != (self.w, self.h):
             img = img.resize((self.w, self.h), Image.BICUBIC)

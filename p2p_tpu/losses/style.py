@@ -2,8 +2,8 @@
 
 The reference carries this as a dead experiment (``gram`` /
 ``calc_Gram_Loss`` at train.py:67-101, call sites commented at
-train.py:370-382); it is live here as an optional loss term for style-
-transfer-flavored configs.
+train.py:370-382); here it is live behind ``LossConfig.lambda_style``
+(consumed by ``build_train_step``).
 
 Gram of NHWC features: per-image G = FᵀF / (H·W·C) over the flattened
 spatial dims (the reference normalizes by h*w*ch — train.py:84-90).

@@ -1,0 +1,165 @@
+// fastimage — native data-path kernels for the host input pipeline.
+//
+// The reference's data path is pure-Python PIL (utils.py:9-12,
+// dataset.py:26-40); at the north-star throughput (thousands of 256x256
+// images/sec/chip) Python decode becomes the bottleneck (SURVEY §7 hard
+// part 6). This module implements the hot path in C++:
+//
+//   - png_decode:      8-bit RGB/RGBA non-interlaced PNG -> RGB bytes
+//                      (zlib inflate + per-row defilter; the formats our
+//                      own generate_dataset writes)
+//   - normalize_f32:   uint8 HWC -> float32 [-1,1] (ToTensor+Normalize(.5))
+//   - quantize_u8:     bit-depth quantizer on uint8 (compress() parity)
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this
+// image). Thread-safe; no global state.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- PNG
+
+static uint32_t be32(const uint8_t* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+static inline int paeth(int a, int b, int c) {
+    int p = a + b - c;
+    int pa = p > a ? p - a : a - p;
+    int pb = p > b ? p - b : b - p;
+    int pc = p > c ? p - c : c - p;
+    if (pa <= pb && pa <= pc) return a;
+    if (pb <= pc) return b;
+    return c;
+}
+
+// Returns 0 on success. Negative error codes:
+//  -1 bad signature  -2 no IHDR  -3 unsupported format  -4 inflate error
+//  -5 size mismatch  -6 bad filter
+// out must hold h*w*3 bytes; w/h are read from the header into *out_w/h
+// after a probe call with out == nullptr.
+int png_decode(const uint8_t* data, int64_t size, uint8_t* out,
+               int64_t* out_w, int64_t* out_h) {
+    static const uint8_t sig[8] = {137, 80, 78, 71, 13, 10, 26, 10};
+    if (size < 8 || std::memcmp(data, sig, 8) != 0) return -1;
+
+    int64_t pos = 8;
+    int64_t w = 0, h = 0;
+    int bit_depth = 0, color_type = 0, interlace = 0;
+    std::vector<uint8_t> idat;
+    bool saw_ihdr = false;
+
+    while (pos + 8 <= size) {
+        uint32_t len = be32(data + pos);
+        const uint8_t* type = data + pos + 4;
+        const uint8_t* body = data + pos + 8;
+        if (pos + 8 + len + 4 > (uint64_t)size) break;
+        if (std::memcmp(type, "IHDR", 4) == 0 && len >= 13) {
+            w = be32(body);
+            h = be32(body + 4);
+            bit_depth = body[8];
+            color_type = body[9];
+            interlace = body[12];
+            saw_ihdr = true;
+        } else if (std::memcmp(type, "IDAT", 4) == 0) {
+            idat.insert(idat.end(), body, body + len);
+        } else if (std::memcmp(type, "IEND", 4) == 0) {
+            break;
+        }
+        pos += 8 + len + 4;  // len + type + body + crc
+    }
+    if (!saw_ihdr) return -2;
+    if (bit_depth != 8 || interlace != 0 ||
+        (color_type != 2 && color_type != 6))
+        return -3;  // only 8-bit RGB/RGBA non-interlaced
+    *out_w = w;
+    *out_h = h;
+    if (out == nullptr) return 0;  // header probe
+
+    const int ch = (color_type == 2) ? 3 : 4;
+    const int64_t stride = w * ch;
+    std::vector<uint8_t> raw((stride + 1) * h);
+    uLongf raw_len = raw.size();
+    if (uncompress(raw.data(), &raw_len, idat.data(), idat.size()) != Z_OK)
+        return -4;
+    if ((int64_t)raw_len != (int64_t)raw.size()) return -5;
+
+    std::vector<uint8_t> prev(stride, 0);
+    std::vector<uint8_t> cur(stride);
+    for (int64_t y = 0; y < h; ++y) {
+        const uint8_t* row = raw.data() + y * (stride + 1);
+        const uint8_t filter = row[0];
+        const uint8_t* src = row + 1;
+        switch (filter) {
+            case 0:
+                std::memcpy(cur.data(), src, stride);
+                break;
+            case 1:  // Sub
+                for (int64_t i = 0; i < stride; ++i)
+                    cur[i] = src[i] + (i >= ch ? cur[i - ch] : 0);
+                break;
+            case 2:  // Up
+                for (int64_t i = 0; i < stride; ++i)
+                    cur[i] = src[i] + prev[i];
+                break;
+            case 3:  // Average
+                for (int64_t i = 0; i < stride; ++i) {
+                    int a = i >= ch ? cur[i - ch] : 0;
+                    cur[i] = src[i] + ((a + prev[i]) >> 1);
+                }
+                break;
+            case 4:  // Paeth
+                for (int64_t i = 0; i < stride; ++i) {
+                    int a = i >= ch ? cur[i - ch] : 0;
+                    int c = i >= ch ? prev[i - ch] : 0;
+                    cur[i] = src[i] + paeth(a, prev[i], c);
+                }
+                break;
+            default:
+                return -6;
+        }
+        // emit RGB
+        uint8_t* dst = out + y * w * 3;
+        if (ch == 3) {
+            std::memcpy(dst, cur.data(), stride);
+        } else {
+            for (int64_t x = 0; x < w; ++x) {
+                dst[x * 3 + 0] = cur[x * 4 + 0];
+                dst[x * 3 + 1] = cur[x * 4 + 1];
+                dst[x * 3 + 2] = cur[x * 4 + 2];
+            }
+        }
+        std::swap(prev, cur);
+    }
+    return 0;
+}
+
+// ------------------------------------------------------------ normalize
+
+// uint8 HWC -> float32 in [-1,1]: x/127.5 - 1  (ToTensor + Normalize(.5))
+void normalize_f32(const uint8_t* src, float* dst, int64_t n) {
+    constexpr float k = 1.0f / 127.5f;
+    for (int64_t i = 0; i < n; ++i) dst[i] = src[i] * k - 1.0f;
+}
+
+// ------------------------------------------------------------- quantize
+
+// bit-depth quantizer on uint8, matching data.generate.compress_uint8:
+// q = round(round(clip(x/255)* (2^b-1)) / (2^b-1) * 255)
+void quantize_u8(const uint8_t* src, uint8_t* dst, int64_t n, int bits) {
+    uint8_t lut[256];
+    const float levels = float((1 << bits) - 1);
+    for (int v = 0; v < 256; ++v) {
+        float x = v / 255.0f;
+        float q = (float)(int64_t)(x * levels + 0.5f) / levels;
+        lut[v] = (uint8_t)(int64_t)(q * 255.0f + 0.5f);
+    }
+    for (int64_t i = 0; i < n; ++i) dst[i] = lut[src[i]];
+}
+
+}  // extern "C"

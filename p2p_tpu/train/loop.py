@@ -101,7 +101,8 @@ class Trainer:
 
         self.vgg_params = (
             load_vgg19_params()
-            if (cfg.loss.lambda_vgg > 0 or cfg.train.eval_fid) else None
+            if (cfg.loss.lambda_vgg > 0 or cfg.loss.lambda_style > 0
+                or cfg.train.eval_fid) else None
         )
         self.fid_feature_fn = None
         self.vgg_source = None
@@ -204,9 +205,14 @@ class Trainer:
 
     def evaluate(self, save_samples: bool = False) -> Dict[str, float]:
         cfg = self.cfg
+        # drop_remainder=False only on a single host: with multiple JAX
+        # processes Grain's ShardByJaxProcess could hand hosts UNEQUAL
+        # batch counts and the extra eval_step's collectives would hang
+        # the other hosts; multi-host eval keeps the even-batch guarantee.
+        full_coverage = jax.process_count() == 1
         loader = make_loader(
             self.test_ds, cfg.data.test_batch_size, shuffle=False,
-            num_epochs=1, drop_remainder=False,
+            num_epochs=1, drop_remainder=not full_coverage,
         )
         psnrs: List[float] = []
         ssims: List[float] = []

@@ -161,6 +161,14 @@ def build_train_step(
                 ) * L.lambda_vgg
                 parts["g_vgg"] = l_vgg
                 total = total + l_vgg
+            if L.lambda_style > 0 and vgg_params is not None:
+                from p2p_tpu.losses.style import style_loss
+
+                l_style = style_loss(
+                    vgg_params, fake_b, real_b, L.vgg_imagenet_norm
+                ) * L.lambda_style
+                parts["g_style"] = l_style
+                total = total + l_style
             if L.lambda_tv > 0:
                 l_tv = total_variation_loss(fake_b) * L.lambda_tv
                 parts["g_tv"] = l_tv

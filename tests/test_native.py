@@ -1,0 +1,86 @@
+"""Native C++ data-path kernels (p2p_tpu.native): PNG decode, normalize,
+quantize — bitwise parity with the PIL/numpy reference path."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from p2p_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _png_bytes(arr, mode="RGB"):
+    buf = io.BytesIO()
+    Image.fromarray(arr, mode).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_png_decode_parity_all_filters():
+    rng = np.random.default_rng(0)
+    from p2p_tpu.data.synthetic import _synthetic_image
+
+    # noise (filter 0/1-heavy) and structured (Paeth/avg-heavy) content
+    cases = [
+        rng.integers(0, 255, (64, 64, 3), dtype=np.uint8),
+        _synthetic_image(rng, (96, 128)),
+        np.zeros((16, 16, 3), np.uint8),
+        np.tile(np.arange(256, dtype=np.uint8), (8, 3, 1)).transpose(0, 2, 1),
+    ]
+    for i, img in enumerate(cases):
+        dec = native.png_decode(_png_bytes(img))
+        assert dec is not None, f"case {i}"
+        np.testing.assert_array_equal(dec, img, err_msg=f"case {i}")
+
+
+def test_png_decode_rgba_drops_alpha():
+    rng = np.random.default_rng(1)
+    rgba = rng.integers(0, 255, (32, 48, 4), dtype=np.uint8)
+    dec = native.png_decode(_png_bytes(rgba, "RGBA"))
+    np.testing.assert_array_equal(dec, rgba[:, :, :3])
+
+
+def test_png_decode_rejects_garbage():
+    assert native.png_decode(b"not a png at all") is None
+
+
+def test_normalize_parity():
+    x = np.arange(256, dtype=np.uint8).reshape(16, 16, 1)
+    out = native.normalize_f32(x)
+    np.testing.assert_allclose(
+        out, x.astype(np.float32) / 127.5 - 1.0, atol=1e-6
+    )
+
+
+def test_quantize_parity_all_bit_depths():
+    from p2p_tpu.data.generate import compress_uint8
+
+    ramp = np.arange(256, dtype=np.uint8).reshape(16, 16, 1)
+    for bits in (1, 2, 3, 4, 8):
+        np.testing.assert_array_equal(
+            native.quantize_u8(ramp, bits), compress_uint8(ramp, bits),
+            err_msg=f"bits={bits}",
+        )
+
+
+def test_dataset_fast_path_matches_pil(tmp_path):
+    """PairedImageDataset item values are identical whichever decode path
+    runs (native for exact-size PNGs, PIL otherwise)."""
+    from p2p_tpu.data.pipeline import PairedImageDataset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=2, n_test=0, size=32)
+    ds = PairedImageDataset(root, "train", image_size=32)
+    item = ds[0]
+    # PIL oracle
+    a = np.asarray(
+        Image.open(os.path.join(ds.b_dir, ds.names[0])).convert("RGB"),
+        np.float32,
+    ) / 127.5 - 1.0
+    np.testing.assert_allclose(item["input"], a, atol=1e-6)
