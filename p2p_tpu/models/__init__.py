@@ -1,4 +1,9 @@
 from p2p_tpu.models.compression import CompressionNetwork
+from p2p_tpu.models.compression_ae import (
+    CompressionAutoencoder,
+    CompressionDecoder,
+    CompressionEncoder,
+)
 from p2p_tpu.models.expand import ExpandNetwork, ResidualBlock
 from p2p_tpu.models.patchgan import MultiscaleDiscriminator, NLayerDiscriminator
 from p2p_tpu.models.pix2pixhd import GlobalGenerator, Pix2PixHDGenerator
@@ -13,6 +18,9 @@ from p2p_tpu.models.registry import define_C, define_D, define_G
 
 __all__ = [
     "CompressionNetwork",
+    "CompressionAutoencoder",
+    "CompressionDecoder",
+    "CompressionEncoder",
     "ExpandNetwork",
     "ResidualBlock",
     "MultiscaleDiscriminator",

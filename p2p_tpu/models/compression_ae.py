@@ -1,0 +1,119 @@
+"""Learned-compression autoencoder — the reference's commented-out
+candidate feature (networks.py:238-392: ``CompressionEncoder``,
+``CompressionResidualBlock``, ``CompressionGenerator``, ``CompressNetwork``),
+implemented live as an optional model family.
+
+Architecture (HiFiC-flavored, widths from the reference):
+- **Encoder** (networks.py:238-289): c7s1-ngf, then 4× [reflect-pad conv
+  k3 s2 + InstanceNorm + ReLU] doubling channels (ngf→16·ngf), project
+  k3 → ``latent_channels`` (reference: 60→960, latent 220).
+- **Decoder** (networks.py:322-384): InstanceNorm → conv k3 → IN, 8
+  residual blocks (IN, no activation after add — networks.py:292-319)
+  with a long skip from the head, 4× ConvTranspose k3 s2 + IN + ReLU
+  halving channels, c7s1-3 out.
+- **CompressionAutoencoder**: decoder∘(optional STE quantizer)∘encoder.
+  The reference's ``CompressNetwork`` stub carries an ``entropy_code``
+  flag with no implementation (networks.py:386-392); entropy coding is
+  likewise out of scope here — the latent quantizer models the rate
+  bottleneck.
+
+TPU notes: InstanceNorm reduces over H,W per (N,C) — see ops.norm (and
+the Pallas fusion for HD shapes); transposed convs lower to MXU-friendly
+conv-gradients under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from p2p_tpu.models.resnet_gen import ResnetBlock
+from p2p_tpu.ops.conv import ConvLayer, normal_init
+from p2p_tpu.ops.norm import InstanceNorm
+from p2p_tpu.ops.quantize import quantize, quantize_ste
+
+
+class CompressionEncoder(nn.Module):
+    ngf: int = 60
+    latent_channels: int = 220
+    n_down: int = 4
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = ConvLayer(self.ngf, kernel_size=7, dtype=self.dtype)(x)
+        y = nn.relu(InstanceNorm(dtype=self.dtype)(y))
+        for i in range(self.n_down):
+            f = self.ngf * (2 ** (i + 1))
+            y = ConvLayer(f, kernel_size=3, stride=2, dtype=self.dtype)(y)
+            y = nn.relu(InstanceNorm(dtype=self.dtype)(y))
+        return ConvLayer(self.latent_channels, kernel_size=3,
+                         dtype=self.dtype)(y)
+
+
+class CompressionDecoder(nn.Module):
+    """Latent channel count is implicit in the input ``z``."""
+
+    ngf: int = 60
+    n_blocks: int = 8
+    n_up: int = 4
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, z):
+        f_top = self.ngf * (2 ** self.n_up)
+        y = InstanceNorm(dtype=self.dtype)(z)
+        y = ConvLayer(f_top, kernel_size=3, dtype=self.dtype)(y)
+        head = InstanceNorm(dtype=self.dtype)(y)
+        y = head
+        # same block as the resnet G family (networks.py:292-319 matches
+        # the classic no-post-add-activation shape)
+        for _ in range(self.n_blocks):
+            y = ResnetBlock(f_top, norm="instance", dtype=self.dtype)(y)
+        y = y + head  # long skip (networks.py:375)
+        for i in reversed(range(self.n_up)):
+            f = self.ngf * (2 ** i)
+            y = nn.ConvTranspose(
+                f, kernel_size=(3, 3), strides=(2, 2), padding="SAME",
+                dtype=self.dtype, kernel_init=normal_init(),
+            )(y)
+            y = nn.relu(InstanceNorm(dtype=self.dtype)(y))
+        return ConvLayer(3, kernel_size=7, dtype=self.dtype)(y)
+
+
+class CompressionAutoencoder(nn.Module):
+    """decode(quantize(encode(x))); latent quantization models the rate
+    bottleneck (``quant_bits=0`` disables it)."""
+
+    ngf: int = 60
+    latent_channels: int = 220
+    n_blocks: int = 8
+    quant_bits: int = 0
+    quant_ste: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    def setup(self):
+        self.encoder = CompressionEncoder(
+            ngf=self.ngf, latent_channels=self.latent_channels,
+            dtype=self.dtype,
+        )
+        self.decoder = CompressionDecoder(
+            ngf=self.ngf, n_blocks=self.n_blocks, dtype=self.dtype,
+        )
+
+    def encode(self, x) -> jax.Array:
+        z = self.encoder(x)
+        if self.quant_bits > 0:
+            q = quantize_ste if self.quant_ste else quantize
+            # latent is unbounded; squash to [0,1] for the bit quantizer
+            z = q(jax.nn.sigmoid(z), self.quant_bits)
+        return z
+
+    def decode(self, z) -> jax.Array:
+        return self.decoder(z)
+
+    def __call__(self, x) -> jax.Array:
+        return self.decode(self.encode(x))
