@@ -56,9 +56,9 @@ def _norm_kernel(x_ref, mean_ref, rstd_ref, scale_ref, bias_ref, y_ref):
     y_ref[...] = y.astype(y_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
-def instance_norm_fused(x, scale=None, bias=None, eps: float = 1e-5,
-                        interpret: bool = False):
+def _fwd_impl(x, scale, bias, eps: float, interpret: bool):
+    """Runs the two Pallas passes; returns (y, mean, rstd) with mean/rstd
+    shaped (N,1,1,C) fp32."""
     n, h, w, c = x.shape
     hb = _pick_h_block(h, w, c)
     nh = h // hb
@@ -99,4 +99,48 @@ def instance_norm_fused(x, scale=None, bias=None, eps: float = 1e-5,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         interpret=interpret,
     )(x, mean, rstd, scale_t, bias_t)
+    return y, mean, rstd
+
+
+# pallas_call has no reverse-mode rule, so the fused forward carries an
+# explicit instance-norm VJP (standard normalization backward; the two
+# backward reductions are small and XLA-fused).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _in_fused(x, scale, bias, eps, interpret):
+    y, _, _ = _fwd_impl(x, scale, bias, eps, interpret)
     return y
+
+
+def _in_fused_fwd(x, scale, bias, eps, interpret):
+    y, mean, rstd = _fwd_impl(x, scale, bias, eps, interpret)
+    return y, (x, scale, bias, mean, rstd)
+
+
+def _in_fused_bwd(eps, interpret, res, g):
+    x, scale, bias, mean, rstd = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    xhat = (x32 - mean) * rstd
+    gamma = (
+        jnp.float32(1.0) if scale is None
+        else scale.reshape(1, 1, 1, -1).astype(jnp.float32)
+    )
+    dxhat = g32 * gamma
+    m1 = jnp.mean(dxhat, axis=(1, 2), keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=(1, 2), keepdims=True)
+    dx = (rstd * (dxhat - m1 - xhat * m2)).astype(x.dtype)
+    if scale is None:
+        dscale = dbias = None
+    else:
+        dscale = jnp.sum(g32 * xhat, axis=(0, 1, 2)).astype(scale.dtype)
+        dbias = jnp.sum(g32, axis=(0, 1, 2)).astype(bias.dtype)
+    return dx, dscale, dbias
+
+
+_in_fused.defvjp(_in_fused_fwd, _in_fused_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def instance_norm_fused(x, scale=None, bias=None, eps: float = 1e-5,
+                        interpret: bool = False):
+    return _in_fused(x, scale, bias, eps, interpret)
