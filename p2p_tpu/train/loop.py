@@ -41,7 +41,7 @@ class MetricsLogger:
         self.print_every = print_every
         self._f = open(path, "a") if path else None
 
-    def log(self, record: Dict[str, Any]) -> None:
+    def log(self, record: Dict[str, Any], force: bool = False) -> None:
         rec = {
             k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v)
             for k, v in record.items()
@@ -50,7 +50,7 @@ class MetricsLogger:
             self._f.write(json.dumps(rec) + "\n")
             self._f.flush()
         step = rec.get("step", 0)
-        if rec.get("kind") == "eval" or step % self.print_every == 0:
+        if force or rec.get("kind") == "eval" or step % self.print_every == 0:
             msg = " ".join(
                 f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in rec.items()
@@ -130,6 +130,13 @@ class Trainer:
         self.train_step = build_train_step(
             cfg, self.vgg_params, self.steps_per_epoch, dtype
         )
+        self.multi_step = None
+        if cfg.train.scan_steps > 1:
+            from p2p_tpu.train.step import build_multi_train_step
+
+            self.multi_step = build_multi_train_step(
+                cfg, self.vgg_params, self.steps_per_epoch, dtype
+            )
         self.eval_step = build_eval_step(cfg, dtype)
         ckpt_dir = os.path.join(
             workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
@@ -176,30 +183,101 @@ class Trainer:
         sums: Optional[Dict[str, jax.Array]] = None
         count = 0
         t0 = time.perf_counter()
-        for batch in device_prefetch(loader, self.batch_sharding):
-            self.state, metrics = self.train_step(self.state, batch)
-            sums = metrics if sums is None else jax.tree_util.tree_map(
-                jax.numpy.add, sums, metrics
+        K = cfg.train.scan_steps
+        first_k = 0       # steps covered by the compile-bearing first dispatch
+        compile_skew = 0.0  # later first-compiles excluded from throughput
+        seen_kinds: set = set()
+        last_logged = 0
+
+        def run(batch_or_stack, k):
+            nonlocal sums, count, t0, first_k, compile_skew, last_logged
+            t_call = time.perf_counter()
+            if k > 1:
+                self.state, metrics = self.multi_step(
+                    self.state, batch_or_stack
+                )
+                step_metrics = jax.tree_util.tree_map(
+                    lambda v: jax.numpy.sum(v, axis=0), metrics
+                )
+                last = jax.tree_util.tree_map(lambda v: v[-1], metrics)
+            else:
+                self.state, last = self.train_step(self.state, batch_or_stack)
+                step_metrics = last
+            if count > 0 and k not in seen_kinds:
+                # first use of this dispatch shape mid-epoch (e.g. the
+                # single-step remainder after scanned dispatches): the call
+                # blocked on trace+compile — keep it out of img_per_sec
+                compile_skew += time.perf_counter() - t_call
+            seen_kinds.add(k)
+            sums = step_metrics if sums is None else jax.tree_util.tree_map(
+                jax.numpy.add, sums, step_metrics
             )
-            count += 1
-            if count == 1:
+            first = count == 0
+            count += k
+            if first:
                 # the first call blocks on trace+XLA compile; exclude it
                 # from the throughput figure (first epoch only, in practice)
+                first_k = k
                 t0 = time.perf_counter()
-            if count % cfg.train.log_every == 0:
-                host = {k: float(v) for k, v in metrics.items()}
+            if count - last_logged >= cfg.train.log_every:
+                last_logged = count
+                host = {kk: float(v) for kk, v in last.items()}
                 self.logger.log(
                     {"kind": "train", "epoch": self.epoch,
-                     "step": int(self.state.step), **host}
+                     "step": int(self.state.step), **host},
+                    force=True,
                 )
+
+        def dispatch_batches():
+            """Yield (device_batch, n_steps): host batches K-stacked for the
+            scan path (stacked on HOST, then placed with the K-extended
+            sharding — stacking already-sharded device arrays would gather)."""
+            if K <= 1:
+                for b in device_prefetch(loader, self.batch_sharding):
+                    yield b, 1
+                return
+            stacked_sh = None
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from p2p_tpu.core.mesh import DATA_AXIS, SPATIAL_AXIS
+
+                stacked_sh = NamedSharding(
+                    self.mesh, P(None, DATA_AXIS, SPATIAL_AXIS, None, None)
+                )
+
+            def gen():
+                pend = []
+                for b in loader:
+                    pend.append(b)
+                    if len(pend) == K:
+                        s = {
+                            kk: np.stack([p[kk] for p in pend])
+                            for kk in pend[0]
+                        }
+                        if stacked_sh is not None:
+                            s = {kk: jax.device_put(v, stacked_sh)
+                                 for kk, v in s.items()}
+                        yield s, K
+                        pend = []
+                for b in pend:  # leftover < K: single-step path
+                    if self.batch_sharding is not None:
+                        b = {kk: jax.device_put(v, self.batch_sharding)
+                             for kk, v in b.items()}
+                    yield b, 1
+
+            yield from device_prefetch(gen(), None, with_aux=True)
+
+        for batch, k in dispatch_batches():
+            run(batch, k)
         if sums is None:
             return {}
         host_sums = jax.device_get(sums)  # fences the epoch's last step
-        elapsed = time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0 - compile_skew
         out = {k: float(v) / count for k, v in host_sums.items()}
-        if count > 1:
+        if count > first_k:
             out["img_per_sec"] = (
-                (count - 1) * cfg.data.batch_size / max(elapsed, 1e-9)
+                (count - first_k) * cfg.data.batch_size / max(elapsed, 1e-9)
             )
         return out
 

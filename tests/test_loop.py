@@ -74,3 +74,31 @@ def test_evaluate_scores_every_test_image(tmp_path):
     result = tr.evaluate()
     assert np.isfinite(result["psnr_mean"])
     assert result["n_images"] == 5  # tail batch scored, padding trimmed
+
+
+def test_trainer_scan_steps_covers_every_batch(tmp_path):
+    """scan_steps=2 over 5 batches/epoch: 2 scanned dispatches + 1
+    single-step remainder — state.step advances by 5 and metric averages
+    cover all steps."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=10, n_test=2, size=16)
+    cfg = get_preset("reference")
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, ngf=4, n_blocks=1, ndf=4,
+                                  num_D=2, n_layers_D=2),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=16,
+                                 threads=0),
+        train=dataclasses.replace(cfg.train, mixed_precision=False,
+                                  scan_steps=2),
+        loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+    )
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path))
+    metrics = tr.train_epoch()
+    assert int(tr.state.step) == 5
+    assert np.isfinite(metrics["loss_g"])
