@@ -119,7 +119,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
     cfg = config_from_flags(args)
 
-    from p2p_tpu.train.loop import Trainer
+    if cfg.data.n_frames > 1:
+        from p2p_tpu.train.video_loop import VideoTrainer as Trainer
+    else:
+        from p2p_tpu.train.loop import Trainer
 
     trainer = Trainer(cfg, data_root=args.data_root, workdir=args.workdir)
     resumed = trainer.maybe_resume()

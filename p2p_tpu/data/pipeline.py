@@ -31,6 +31,24 @@ from PIL import Image
 from p2p_tpu.data.generate import is_image_file
 
 
+def load_image(path: str, h: int, w: int) -> np.ndarray:
+    """Decode + resize-to-(h,w) + normalize to float32 [-1,1].
+
+    Native C++ fast path (p2p_tpu.native) for PNGs already at target size
+    (header probe before any inflate work); PIL + bicubic resize otherwise.
+    Normalize(.5,.5,.5) semantics: x/127.5 - 1.
+    """
+    from p2p_tpu import native
+
+    fast = native.load_image_fast(path, expect_hw=(h, w))
+    if fast is not None:
+        return fast[1]
+    img = Image.open(path).convert("RGB")
+    if img.size != (w, h):
+        img = img.resize((w, h), Image.BICUBIC)
+    return np.asarray(img, np.float32) / 127.5 - 1.0
+
+
 class PairedImageDataset:
     """Random-access paired dataset; items are dicts of float32 [-1,1] HWC."""
 
@@ -57,19 +75,7 @@ class PairedImageDataset:
         return len(self.names)
 
     def _load(self, path: str) -> np.ndarray:
-        # native C++ decode+normalize fast path (p2p_tpu.native) when the
-        # file is a PNG already at target size (checked via a header probe
-        # before any inflate work); PIL otherwise
-        from p2p_tpu import native
-
-        fast = native.load_image_fast(path, expect_hw=(self.h, self.w))
-        if fast is not None:
-            return fast[1]
-        img = Image.open(path).convert("RGB")
-        if img.size != (self.w, self.h):
-            img = img.resize((self.w, self.h), Image.BICUBIC)
-        x = np.asarray(img, np.float32) / 255.0
-        return x * 2.0 - 1.0  # Normalize(.5,.5,.5) semantics
+        return load_image(path, self.h, self.w)
 
     def __getitem__(self, idx: int):
         if hasattr(idx, "__index__"):

@@ -278,6 +278,25 @@ def build_video_train_step(
     return step
 
 
+def build_multi_video_train_step(
+    cfg: Config,
+    vgg_params: Optional[Any] = None,
+    steps_per_epoch: int = 1,
+    train_dtype=None,
+):
+    """K video steps per dispatch via lax.scan (the video analogue of
+    ``p2p_tpu.train.step.build_multi_train_step``); ``batches`` carry a
+    leading (K,) scan axis over NTHWC clips."""
+    inner = build_video_train_step(
+        cfg, vgg_params, steps_per_epoch, train_dtype, jit=False
+    )
+
+    def multi_step(state: VideoTrainState, batches: Dict[str, jax.Array]):
+        return jax.lax.scan(inner, state, batches)
+
+    return jax.jit(multi_step, donate_argnums=0)
+
+
 def make_parallel_video_step(
     cfg: Config,
     mesh,
