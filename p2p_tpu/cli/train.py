@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["deconv", "resize"],
                    help="U-Net decoder upsampling (deconv = torch-parity "
                         "ConvTranspose; resize = nearest+conv)")
+    p.add_argument("--augment", action="store_true", default=None,
+                   help="paired resize-286/random-crop/flip augmentation")
     # --- reference flags (train.py:133-157), same names/defaults ---------
     p.add_argument("--dataset", type=str, default=None, help="facades")
     p.add_argument("--name", type=str, default=None, help="training name")
@@ -86,7 +88,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  niter=args.niter, niter_decay=args.niter_decay)
     data = over(data, dataset=args.dataset, direction=args.direction,
                 batch_size=args.batch_size, image_size=args.image_size,
-                test_batch_size=args.test_batch_size, threads=args.threads)
+                test_batch_size=args.test_batch_size, threads=args.threads,
+                augment=args.augment)
     train = over(train, nepoch=args.nepoch, epoch_count=args.epoch_count,
                  epoch_save=args.epochsave, seed=args.seed)
     if args.mesh is not None:

@@ -97,6 +97,9 @@ class DataConfig:
     batch_size: int = 1              # train.py:143
     test_batch_size: int = 1
     threads: int = 4
+    # Paired augmentation (the reference's commented-out resize-286 +
+    # random-crop-256 + flip, dataset.py:28-46) on the train split.
+    augment: bool = False
     # Video clips for vid2vid-style configs
     n_frames: int = 1
 

@@ -81,13 +81,25 @@ class PairedImageDataset:
         if hasattr(idx, "__index__"):
             idx = idx.__index__()
         name = self.names[idx]
-        a = self._load(os.path.join(self.a_dir, name))
-        b = self._load(os.path.join(self.b_dir, name))
         if self.augment:
-            # reference's commented-out aug: resize 286 + random 256-crop + flip
-            rng = np.random.default_rng((idx * 2654435761) & 0xFFFFFFFF)
+            # the reference's commented-out aug (dataset.py:28-46): load at
+            # 286/256-scaled size, take the SAME random crop from a and b,
+            # flip both. Fresh entropy per call → new crops every epoch.
+            lh = self.h * 286 // 256
+            lw = self.w * 286 // 256
+            a = load_image(os.path.join(self.a_dir, name), lh, lw)
+            b = load_image(os.path.join(self.b_dir, name), lh, lw)
+            rng = np.random.default_rng()
+            oy = int(rng.integers(0, lh - self.h + 1))
+            ox = int(rng.integers(0, lw - self.w + 1))
+            a = a[oy : oy + self.h, ox : ox + self.w]
+            b = b[oy : oy + self.h, ox : ox + self.w]
             if rng.random() < 0.5:
-                a, b = a[:, ::-1].copy(), b[:, ::-1].copy()
+                a, b = a[:, ::-1], b[:, ::-1]
+            a, b = np.ascontiguousarray(a), np.ascontiguousarray(b)
+        else:
+            a = self._load(os.path.join(self.a_dir, name))
+            b = self._load(os.path.join(self.b_dir, name))
         if self.direction == "a2b":
             return {"input": a, "target": b}
         return {"input": b, "target": a}

@@ -76,7 +76,7 @@ class Trainer:
         root = data_root or os.path.join(cfg.data.root, cfg.data.dataset)
         self.train_ds = PairedImageDataset(
             root, "train", cfg.data.direction, cfg.data.image_size,
-            cfg.data.image_width,
+            cfg.data.image_width, augment=cfg.data.augment,
         )
         self.test_ds = PairedImageDataset(
             root, "test", cfg.data.direction, cfg.data.image_size,
