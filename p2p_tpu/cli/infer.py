@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--image_size", type=int, default=None)
     p.add_argument("--ngf", type=int, default=None)
+    p.add_argument("--ndf", type=int, default=None,
+                   help="discriminator width — needed to rebuild the "
+                        "checkpoint template for full-state restore")
     p.add_argument("--n_blocks", type=int, default=None)
     p.add_argument("--upsample_mode", type=str, default=None,
                    choices=["deconv", "resize"])
@@ -66,10 +69,12 @@ def main(argv=None) -> int:
     cfg = get_preset(args.preset)
     data = over(cfg.data, dataset=args.dataset, direction=args.direction,
                 test_batch_size=args.batch_size, image_size=args.image_size)
-    model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks,
-                 upsample_mode=args.upsample_mode)
+    model = over(cfg.model, ngf=args.ngf, ndf=args.ndf,
+                 n_blocks=args.n_blocks, upsample_mode=args.upsample_mode)
     cfg = dataclasses.replace(cfg, data=data, model=model,
                               name=args.name or cfg.name)
+    if cfg.data.n_frames > 1:
+        return _video_main(args, cfg)
 
     root = args.data_root or os.path.join(cfg.data.root, cfg.data.dataset)
     try:
@@ -120,6 +125,72 @@ def main(argv=None) -> int:
         if n_saved >= len(ds):
             break
     print(f"wrote {n_saved} predictions (checkpoint step {step}) to {out_dir}")
+    return 0
+
+
+def _video_main(args, cfg) -> int:
+    """Clip inference: per-frame predictions written as
+    <out>/<video>_<frame>.png (video configs, n_frames>1)."""
+    import jax
+    import numpy as np
+
+    from p2p_tpu.data.pipeline import make_loader
+    from p2p_tpu.data.video import VideoClipDataset
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.video_loop import build_video_eval_step
+    from p2p_tpu.train.video_step import create_video_train_state
+    from p2p_tpu.utils.images import save_img
+
+    root = args.data_root or os.path.join(cfg.data.root, cfg.data.dataset)
+    try:
+        ds = VideoClipDataset(
+            root, "test", cfg.data.direction, cfg.data.image_size,
+            cfg.data.image_width, n_frames=cfg.data.n_frames,
+        )
+    except (RuntimeError, FileNotFoundError) as e:
+        print(f"no test clips under {root}: {e}", file=sys.stderr)
+        return 1
+
+    ckpt_dir = os.path.join(
+        args.workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
+    )
+    ckpt = CheckpointManager(ckpt_dir)
+    step = args.step if args.step is not None else ckpt.latest_step()
+    if step is None:
+        print(f"no checkpoint found under {ckpt_dir}", file=sys.stderr)
+        return 1
+
+    bs = cfg.data.test_batch_size
+    sample = ds[0]
+    sample_batch = {
+        k: np.broadcast_to(v, (bs,) + v.shape).copy() for k, v in sample.items()
+    }
+    state = create_video_train_state(cfg, jax.random.key(0), sample_batch)
+    state = ckpt.restore(state, step)
+    eval_step = build_video_eval_step(cfg)
+
+    out_dir = args.out or os.path.join(
+        args.workdir, cfg.train.result_dir, cfg.data.dataset
+    )
+    os.makedirs(out_dir, exist_ok=True)
+
+    n_clip = 0
+    n_frames = 0
+    for batch in make_loader(ds, bs, shuffle=False, num_epochs=1,
+                             drop_remainder=False):
+        pred, _ = eval_step(state, batch)
+        pred = np.asarray(pred, np.float32)
+        for i in range(pred.shape[0]):
+            if n_clip >= len(ds):
+                break
+            vid, frames = ds.windows[n_clip]
+            for t, fname in enumerate(frames):
+                stem = os.path.splitext(fname)[0]
+                save_img(pred[i, t], os.path.join(out_dir, f"{vid}_{stem}.png"))
+                n_frames += 1
+            n_clip += 1
+    print(f"wrote {n_frames} frames / {n_clip} clips "
+          f"(checkpoint step {step}) to {out_dir}")
     return 0
 
 

@@ -157,3 +157,44 @@ def test_loader_keeps_tail_batch_when_asked():
         dropped = list(make_loader(ds, 3, shuffle=False, num_epochs=1))
         assert sum(b["input"].shape[0] for b in kept) == 5
         assert sum(b["input"].shape[0] for b in dropped) == 3
+
+
+def test_video_train_and_infer_cli_end_to_end(tmp_path):
+    """vid2vid preset routes train to VideoTrainer and infer to the clip
+    path; every test frame gets a prediction file."""
+    from p2p_tpu.data.video import make_synthetic_video_dataset
+
+    ds = str(tmp_path / "ds" / "vid2vid")
+    make_synthetic_video_dataset(ds, n_videos=2, n_frames=4, size=16)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+    common = ["--preset", "vid2vid_temporal", "--name", "v", "--image_size",
+              "16", "--ngf", "4", "--ndf", "4", "--data_root", ds]
+    r = subprocess.run(
+        [sys.executable, "-m", "p2p_tpu.cli.train", *common,
+         "--nepoch", "1", "--epochsave", "1", "--batch_size", "2",
+         "--threads", "0", "--mesh", "1,1,1"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=900,
+    )
+    # preset n_frames=8 > video length 4 would find no windows; CLI lacks a
+    # frames flag by design (clip length is a dataset property) — use 8-frame
+    # videos instead
+    if r.returncode != 0 and "windows" in (r.stderr or ""):
+        make_synthetic_video_dataset(ds, n_videos=2, n_frames=8, size=16)
+        r = subprocess.run(
+            [sys.executable, "-m", "p2p_tpu.cli.train", *common,
+             "--nepoch", "1", "--epochsave", "1", "--batch_size", "2",
+             "--threads", "0", "--mesh", "1,1,1"],
+            cwd=tmp_path, env=env, capture_output=True, text=True,
+            timeout=900,
+        )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    r = subprocess.run(
+        [sys.executable, "-m", "p2p_tpu.cli.infer", *common,
+         "--out", str(tmp_path / "pred")],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    preds = os.listdir(tmp_path / "pred")
+    assert len(preds) == 16  # 2 videos x 8 frames
