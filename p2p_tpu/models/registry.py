@@ -63,6 +63,14 @@ def define_G(cfg: ModelConfig, dtype=None, remat: bool = False) -> nn.Module:
             n_blocks_global=cfg.n_blocks, norm=cfg.norm,
             remat=remat, dtype=dtype,
         )
+    if cfg.generator == "pix2pixhd_global":
+        # phase 1 of the coarse-to-fine schedule: G1 alone at half res
+        from p2p_tpu.models.pix2pixhd import GlobalGenerator
+
+        return GlobalGenerator(
+            ngf=cfg.ngf, out_channels=cfg.output_nc, n_blocks=cfg.n_blocks,
+            norm=cfg.norm, remat=remat, dtype=dtype,
+        )
     raise ValueError(f"unknown generator {cfg.generator!r}")
 
 

@@ -201,7 +201,8 @@ def test_pix2pixhd_generator_shapes_and_param_split():
 def test_registry_builds_all_generator_families():
     x = jnp.zeros((1, 32, 32, 3))
     for gen, norm in [("expand", "batch"), ("unet", "batch"),
-                      ("resnet", "instance"), ("pix2pixhd", "instance")]:
+                      ("resnet", "instance"), ("pix2pixhd", "instance"),
+                      ("pix2pixhd_global", "instance")]:
         cfg = ModelConfig(generator=gen, ngf=8, n_blocks=2, norm=norm)
         g = define_G(cfg)
         variables = init_variables(g, jax.random.key(0), x, train=True)
