@@ -352,6 +352,15 @@ class Trainer:
         self.logger.log({"kind": "eval", "epoch": self.epoch, **result})
         return result
 
+    def current_lr(self) -> Optional[float]:
+        """Effective generator LR: the schedule value inside the optimizer
+        state (inject_hyperparams) times the host plateau scale."""
+        try:
+            hp = self.state.opt_g.hyperparams["learning_rate"]
+            return float(np.asarray(hp)) * float(np.asarray(self.state.lr_scale))
+        except (AttributeError, KeyError, TypeError):
+            return None
+
     def fit(self, nepoch: Optional[int] = None) -> List[Dict[str, float]]:
         cfg = self.cfg
         nepoch = nepoch or cfg.train.nepoch
@@ -361,6 +370,9 @@ class Trainer:
             train_metrics = self.train_epoch(seed=self.epoch)
             record = {"epoch": self.epoch, "sec": time.time() - t0,
                       **train_metrics}
+            lr = self.current_lr()
+            if lr is not None:  # reference prints LR per epoch (networks.py:125)
+                record["lr"] = lr
             if cfg.train.eval_every_epoch:
                 record.update(self.evaluate(save_samples=True))
             history.append(record)
