@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Reproduction commands for the five BASELINE.json target configs (plus the
+# reference-faithful run). Each assumes a paired dataset generated with
+# p2p_tpu.cli.generate_dataset (or, for vid2vid, per-video frame dirs —
+# see p2p_tpu/data/video.py for the layout).
+set -euo pipefail
+
+# 0. reference-faithful: ExpandNetwork + CompressionNetwork + 3-scale D,
+#    LSGAN + feature-matching + VGG + TV (train.py parity)
+python -m p2p_tpu.cli.train --preset reference --dataset facades --name ref
+
+# 1. facades 256^2 classic pix2pix (U-Net + 70x70 PatchGAN + L1, bs=1)
+python -m p2p_tpu.cli.train --preset facades --dataset facades --name px
+
+# 2. edges2shoes bs=64 data-parallel (gradient psum over the data axis)
+python -m p2p_tpu.cli.train --preset edges2shoes_dp --dataset edges2shoes \
+    --name e2s --mesh -1,1,1
+
+# 3. Cityscapes 512x256 GSPMD spatial shard (H over 2 shards, conv halos
+#    inserted by the partitioner)
+python -m p2p_tpu.cli.train --preset cityscapes_spatial --dataset cityscapes \
+    --name cs --mesh -1,2,1
+
+# 4. pix2pixHD 1024x512 (Pallas fused InstanceNorm, remat, global+local G).
+#    Optional coarse-to-fine: pretrain G1 first via the global-only family.
+python -m p2p_tpu.cli.train --preset pix2pixhd --dataset cityscapes_hd \
+    --name hd --mesh -1,2,1
+
+# 5. vid2vid 8-frame temporal D, sequence-parallel over the time axis
+python -m p2p_tpu.cli.train --preset vid2vid_temporal --dataset vid2vid \
+    --name v2v --mesh -1,1,4
+
+# Inference from any of the runs:
+#   python -m p2p_tpu.cli.infer --preset <preset> --dataset <ds> --name <name>
