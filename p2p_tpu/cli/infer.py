@@ -44,6 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_blocks", type=int, default=None)
     p.add_argument("--upsample_mode", type=str, default=None,
                    choices=["deconv", "resize"])
+    p.add_argument("--metrics", action="store_true",
+                   help="also print mean/max PSNR+SSIM vs the targets")
     return p
 
 
@@ -110,12 +112,16 @@ def main(argv=None) -> int:
     os.makedirs(out_dir, exist_ok=True)
 
     n_saved = 0
+    psnrs, ssims = [], []
     # drop_remainder=False: EVERY test image gets a prediction (the final
     # partial batch costs one extra compile at its smaller shape)
     for batch in make_loader(ds, bs, shuffle=False, num_epochs=1,
                              drop_remainder=False):
-        pred, _ = eval_step(state, batch)
+        pred, metrics = eval_step(state, batch)
         pred = np.asarray(pred, np.float32)
+        if args.metrics:
+            psnrs.extend(np.asarray(metrics["psnr"]).ravel().tolist())
+            ssims.extend(np.asarray(metrics["ssim"]).ravel().tolist())
         for i in range(pred.shape[0]):
             name = ds.names[n_saved] if n_saved < len(ds.names) else f"{n_saved}.png"
             save_img(pred[i], os.path.join(out_dir, name))
@@ -125,6 +131,9 @@ def main(argv=None) -> int:
         if n_saved >= len(ds):
             break
     print(f"wrote {n_saved} predictions (checkpoint step {step}) to {out_dir}")
+    if args.metrics and psnrs:
+        print(f"psnr_mean={np.mean(psnrs):.4f} psnr_max={np.max(psnrs):.4f} "
+              f"ssim_mean={np.mean(ssims):.4f} ssim_max={np.max(ssims):.4f}")
     return 0
 
 
