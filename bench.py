@@ -43,7 +43,7 @@ def main() -> None:
     on_tpu = platform == "tpu"
     preset = os.environ.get("BENCH_PRESET", "facades")
     img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
-    bs = int(os.environ.get("BENCH_BS", "64" if on_tpu else "2"))
+    bs = int(os.environ.get("BENCH_BS", "128" if on_tpu else "2"))
     scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "2"))
     n_calls = int(os.environ.get("BENCH_STEPS", "64" if on_tpu else "4")) // scan_k
     n_calls = max(n_calls, 2)

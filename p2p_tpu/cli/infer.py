@@ -185,10 +185,14 @@ def _video_main(args, cfg) -> int:
 
     n_clip = 0
     n_frames = 0
+    psnrs, ssims = [], []
     for batch in make_loader(ds, bs, shuffle=False, num_epochs=1,
                              drop_remainder=False):
-        pred, _ = eval_step(state, batch)
+        pred, metrics = eval_step(state, batch)
         pred = np.asarray(pred, np.float32)
+        if args.metrics:
+            psnrs.extend(np.asarray(metrics["psnr"]).ravel().tolist())
+            ssims.extend(np.asarray(metrics["ssim"]).ravel().tolist())
         for i in range(pred.shape[0]):
             if n_clip >= len(ds):
                 break
@@ -200,6 +204,9 @@ def _video_main(args, cfg) -> int:
             n_clip += 1
     print(f"wrote {n_frames} frames / {n_clip} clips "
           f"(checkpoint step {step}) to {out_dir}")
+    if args.metrics and psnrs:
+        print(f"psnr_mean={np.mean(psnrs):.4f} psnr_max={np.max(psnrs):.4f} "
+              f"ssim_mean={np.mean(ssims):.4f} ssim_max={np.max(ssims):.4f}")
     return 0
 
 

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from p2p_tpu.ops.conv import normal_init
+from p2p_tpu.ops.conv import normal_init, save_conv_out
 from p2p_tpu.ops.spectral_norm import SpectralConv
 
 
@@ -54,14 +54,14 @@ class _PlainConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        return nn.Conv(
+        return save_conv_out(nn.Conv(
             self.features,
             kernel_size=(4, 4),
             strides=(self.stride, self.stride),
             padding=self.padding,
             dtype=self.dtype,
             kernel_init=normal_init(),
-        )(x)
+        )(x))
 
 
 class NLayerDiscriminator(nn.Module):

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from p2p_tpu.models.patchgan import avg_pool_downsample
-from p2p_tpu.ops.conv import normal_init
+from p2p_tpu.ops.conv import normal_init, save_conv_out
 from p2p_tpu.ops.spectral_norm import _l2norm, spectral_normalize
 
 
@@ -102,7 +102,7 @@ class SpectralConv3D(nn.Module):
                 "bias", nn.initializers.zeros, (self.features,), jnp.float32
             )
             y = y + bias.astype(y.dtype)
-        return y
+        return save_conv_out(y)
 
 
 class TemporalDiscriminator(nn.Module):

@@ -23,7 +23,7 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from p2p_tpu.ops.conv import UpsampleConvLayer, normal_init
+from p2p_tpu.ops.conv import UpsampleConvLayer, normal_init, save_conv_out
 from p2p_tpu.ops.norm import make_norm
 
 
@@ -55,10 +55,10 @@ class UNetGenerator(nn.Module):
                         pow2_levels(x.shape[2]))
 
         def down_conv(y, features, name):
-            return nn.Conv(
+            return save_conv_out(nn.Conv(
                 features, kernel_size=(4, 4), strides=(2, 2), padding=1,
                 dtype=self.dtype, kernel_init=normal_init(), name=name,
-            )(y)
+            )(y))
 
         # ---- encoder ----------------------------------------------------
         feats = [min(self.ngf * (2 ** i), self.ngf * 8)
@@ -79,11 +79,11 @@ class UNetGenerator(nn.Module):
             f = self.out_channels if i == 0 else feats[i - 1]
             y = nn.relu(y)
             if self.upsample_mode == "deconv":
-                y = nn.ConvTranspose(
+                y = save_conv_out(nn.ConvTranspose(
                     f, kernel_size=(4, 4), strides=(2, 2), padding="SAME",
                     dtype=self.dtype, kernel_init=normal_init(),
                     name=f"up{i}",
-                )(y)
+                )(y))
             else:
                 y = UpsampleConvLayer(
                     f, kernel_size=3, upsample=2, dtype=self.dtype,

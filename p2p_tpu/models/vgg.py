@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from p2p_tpu.ops.conv import save_conv_out
+
 # (name, out_channels); 'M' = maxpool. Standard VGG19 trunk through conv5_1.
 _CFG = [
     ("conv1_1", 64), ("conv1_2", 64), ("M", 0),
@@ -60,9 +62,9 @@ class VGG19Features(nn.Module):
             if name == "M":
                 y = nn.max_pool(y, (2, 2), strides=(2, 2))
                 continue
-            y = nn.Conv(
+            y = save_conv_out(nn.Conv(
                 ch, kernel_size=(3, 3), padding=1, dtype=self.dtype, name=name
-            )(y)
+            )(y))
             y = nn.relu(y)
             if name in _TAPS:
                 outs.append(y)

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from p2p_tpu.ops.conv import normal_init
+from p2p_tpu.ops.conv import normal_init, save_conv_out
 
 
 def _l2norm(x, eps=1e-12):
@@ -106,4 +106,4 @@ class SpectralConv(nn.Module):
                 "bias", nn.initializers.zeros, (self.features,), jnp.float32
             )
             y = y + bias.astype(y.dtype)
-        return y
+        return save_conv_out(y)
