@@ -42,21 +42,30 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     preset = os.environ.get("BENCH_PRESET", "facades")
-    img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
-    bs = int(os.environ.get("BENCH_BS", "128" if on_tpu else "2"))
+    cfg = get_preset(preset)
+    # BENCH_IMG overrides to a square size; otherwise non-default presets
+    # bench at their NATIVE dims (e.g. pix2pixhd 1024×512), facades at 256².
+    if "BENCH_IMG" in os.environ or preset == "facades" or not on_tpu:
+        img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
+        wid = None
+    else:
+        img, wid = cfg.data.image_size, cfg.data.image_width
+    bs = int(os.environ.get("BENCH_BS", ("128" if preset == "facades" else
+                                         str(cfg.data.batch_size)) if on_tpu
+                            else "2"))
     scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "2"))
     n_calls = int(os.environ.get("BENCH_STEPS", "64" if on_tpu else "4")) // scan_k
     n_calls = max(n_calls, 2)
 
-    cfg = get_preset(preset)
     cfg = cfg.replace(
         data=dataclasses.replace(
-            cfg.data, batch_size=bs, image_size=img, image_width=None
+            cfg.data, batch_size=bs, image_size=img, image_width=wid
         )
     )
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
-    host = synthetic_batch(batch_size=bs, size=img, bits=cfg.model.quant_bits)
+    host = synthetic_batch(batch_size=bs, size=img, bits=cfg.model.quant_bits,
+                           width=wid)
     single = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
     batches = {
         k: jnp.asarray(np.broadcast_to(v, (scan_k,) + v.shape).copy(),
@@ -95,8 +104,9 @@ def main() -> None:
     comparable = on_tpu and img == 256 and preset in (
         "facades", "edges2shoes_dp"
     )
+    dims = f"{img}x{wid}" if wid else f"{img}px"
     print(json.dumps({
-        "metric": f"train_throughput_{preset}_{platform}_{img}px_bs{bs}",
+        "metric": f"train_throughput_{preset}_{platform}_{dims}_bs{bs}",
         "value": round(img_per_sec, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(img_per_sec / baseline, 4) if comparable else 0.0,

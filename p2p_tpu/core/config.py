@@ -13,7 +13,7 @@ Reference flag parity (train.py:133-157) is kept by ``Config.from_flags`` in
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 from p2p_tpu.core.mesh import MeshSpec
 
@@ -110,8 +110,11 @@ class ParallelConfig:
     # Sync batch-norm statistics across the data axis (pmean). At bs=1 per
     # device this is the only way BatchNorm matches reference semantics.
     sync_batchnorm: bool = True
-    # Remat (jax.checkpoint) the generator blocks to trade FLOPs for HBM.
-    remat: bool = False
+    # Remat the generator blocks to trade FLOPs/recompute for HBM:
+    # False = off; True/"full" = classic full remat (min memory, recomputes
+    # block convs); "conv" = save conv outputs + norm stats, recompute only
+    # elementwise chains (policy remat — no extra MXU work).
+    remat: Union[bool, str] = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -230,7 +233,10 @@ _register(
         loss=LossConfig(lambda_feat=10.0, lambda_vgg=10.0, lambda_tv=0.0),
         data=DataConfig(dataset="cityscapes_hd", image_size=512,
                         image_width=1024, batch_size=1),
-        parallel=ParallelConfig(mesh=MeshSpec(data=-1, spatial=2), remat=True),
+        # remat off: 1024×512 bs=1 fits single-chip HBM and full remat
+        # costs 20% (README perf table); switch to remat="conv" (keep conv
+        # outputs, recompute elementwise) on tighter-memory meshes.
+        parallel=ParallelConfig(mesh=MeshSpec(data=-1, spatial=2)),
     )
 )
 

@@ -10,7 +10,7 @@ real dataset is mounted.
 from __future__ import annotations
 
 import os
-from typing import Tuple
+from typing import Tuple, Optional
 
 import numpy as np
 from PIL import Image
@@ -69,12 +69,17 @@ def make_synthetic_dataset(
 
 
 def synthetic_batch(
-    batch_size: int = 1, size: int = 64, bits: int = 3, seed: int = 0
+    batch_size: int = 1, size: int = 64, bits: int = 3, seed: int = 0,
+    width: Optional[int] = None,
 ):
-    """In-memory batch dict {'input','target'} in [-1,1], b2a direction."""
+    """In-memory batch dict {'input','target'} in [-1,1], b2a direction.
+
+    ``size`` is the height; ``width`` defaults to square (the wide presets —
+    Cityscapes 512×256, pix2pixHD 1024×512 — pass it explicitly)."""
     rng = np.random.default_rng(seed)
     targets = np.stack(
-        [_synthetic_image(rng, (size, size)) for _ in range(batch_size)]
+        [_synthetic_image(rng, (size, width or size))
+         for _ in range(batch_size)]
     )
     inputs = np.stack([compress_uint8(t, bits) for t in targets])
     to_f = lambda x: x.astype(np.float32) / 127.5 - 1.0

@@ -20,13 +20,13 @@ when spatial extents are large.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Union
 
 import jax.numpy as jnp
 from flax import linen as nn
 
 from p2p_tpu.ops.activations import PReLU
-from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer
+from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
 from p2p_tpu.ops.norm import make_norm
 from p2p_tpu.ops.pixel_shuffle import pixel_unshuffle
 from p2p_tpu.ops.conv import upsample_nearest
@@ -56,7 +56,7 @@ class ExpandNetwork(nn.Module):
     n_blocks: int = 9
     out_channels: int = 3
     norm: str = "batch"
-    remat: bool = False
+    remat: Union[bool, str] = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -71,12 +71,12 @@ class ExpandNetwork(nn.Module):
         y = act(mk()(ConvLayer(self.ngf * 2, kernel_size=3, stride=2, dtype=self.dtype)(y)))
         y = act(mk()(ConvLayer(self.ngf * 4, kernel_size=3, stride=2, dtype=self.dtype)(y)))
 
-        block_cls = ResidualBlock
-        if self.remat:
-            block_cls = nn.remat(ResidualBlock, static_argnums=(2,))
+        block_cls = remat_wrap(ResidualBlock, self.remat)
         residual = y
-        for _ in range(self.n_blocks):
-            y = block_cls(self.ngf * 4, norm=self.norm, dtype=self.dtype)(y, train)
+        for i in range(self.n_blocks):
+            # explicit name: remat wrapping must not change param paths
+            y = block_cls(self.ngf * 4, norm=self.norm, dtype=self.dtype,
+                          name=f"ResidualBlock_{i}")(y, train)
         y = nn.leaky_relu(y + residual, negative_slope=0.2)
 
         y = act(mk()(UpsampleConvLayer(self.ngf * 2, kernel_size=3, upsample=2, dtype=self.dtype)(y)))

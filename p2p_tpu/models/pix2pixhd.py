@@ -13,20 +13,22 @@ Width convention matches the torch lineage: ``ngf`` names the GLOBAL
 generator width (paper: 64); the enhancer runs at ``ngf//2``.
 
 TPU-first: InstanceNorm here is the Pallas-fused kernel when the preset
-says so (norm='pallas_instance'); the trunk remats under
-``ParallelConfig.remat`` since 1024×512 activations dominate HBM.
+says so (norm='pallas_instance'). The trunk honors ``ParallelConfig.remat``
+(off by default — 1024×512 bs=1 fits single-chip HBM and full remat costs
+20%; 'conv' keeps conv outputs and recomputes only elementwise chains for
+tighter-memory meshes).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Union
 
 import jax.numpy as jnp
 from flax import linen as nn
 
 from p2p_tpu.models.patchgan import avg_pool_downsample
 from p2p_tpu.models.resnet_gen import ResnetBlock, ResnetGenerator
-from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer
+from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
 from p2p_tpu.ops.norm import make_norm
 
 
@@ -36,7 +38,7 @@ def GlobalGenerator(
     n_blocks: int = 9,
     norm: str = "instance",
     return_features: bool = False,
-    remat: bool = False,
+    remat: Union[bool, str] = False,
     dtype=None,
     name: Optional[str] = None,
 ) -> ResnetGenerator:
@@ -57,7 +59,7 @@ class Pix2PixHDGenerator(nn.Module):
     n_blocks_global: int = 9
     n_blocks_local: int = 3
     norm: str = "instance"
-    remat: bool = False
+    remat: Union[bool, str] = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -81,11 +83,11 @@ class Pix2PixHDGenerator(nn.Module):
 
         # fuse + local trunk
         y = y + g1_feats
-        block_cls = ResnetBlock
-        if self.remat:
-            block_cls = nn.remat(ResnetBlock, static_argnums=(2,))
-        for _ in range(self.n_blocks_local):
-            y = block_cls(self.ngf, norm=self.norm, dtype=self.dtype)(y, train)
+        block_cls = remat_wrap(ResnetBlock, self.remat)
+        for i in range(self.n_blocks_local):
+            # explicit name: remat wrapping must not change param paths
+            y = block_cls(self.ngf, norm=self.norm, dtype=self.dtype,
+                          name=f"ResnetBlock_{i}")(y, train)
 
         y = UpsampleConvLayer(ngf_local, kernel_size=3, upsample=2,
                               dtype=self.dtype)(y)

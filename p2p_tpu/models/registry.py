@@ -26,7 +26,7 @@ def define_C(cfg: ModelConfig, dtype=None) -> nn.Module:
     return CompressionNetwork(dtype=dtype)
 
 
-def define_G(cfg: ModelConfig, dtype=None, remat: bool = False) -> nn.Module:
+def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
     if cfg.generator == "expand":
         return ExpandNetwork(
             ngf=cfg.ngf,

@@ -14,12 +14,12 @@ is optionally rematerialized; upsampling is nearest-resize + conv.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Union
 
 import jax.numpy as jnp
 from flax import linen as nn
 
-from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer
+from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
 from p2p_tpu.ops.norm import make_norm
 
 
@@ -53,7 +53,7 @@ class ResnetGenerator(nn.Module):
     norm: str = "instance"
     max_features: Optional[int] = None
     return_features: bool = False
-    remat: bool = False
+    remat: Union[bool, str] = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -68,12 +68,14 @@ class ResnetGenerator(nn.Module):
             y = ConvLayer(f, kernel_size=3, stride=2, dtype=self.dtype)(y)
             y = nn.relu(mk()(y))
 
-        block_cls = ResnetBlock
-        if self.remat:
-            block_cls = nn.remat(ResnetBlock, static_argnums=(2,))
+        block_cls = remat_wrap(ResnetBlock, self.remat)
         f_trunk = min(self.ngf * (2 ** self.n_downsampling), cap)
-        for _ in range(self.n_blocks):
-            y = block_cls(f_trunk, norm=self.norm, dtype=self.dtype)(y, train)
+        for i in range(self.n_blocks):
+            # explicit name: remat wrapping must not change param paths
+            # (nn.remat's auto-name is 'CheckpointResnetBlock_i', which
+            # would silently re-key checkpoints when remat is toggled)
+            y = block_cls(f_trunk, norm=self.norm, dtype=self.dtype,
+                          name=f"ResnetBlock_{i}")(y, train)
 
         for i in reversed(range(self.n_downsampling)):
             f = min(self.ngf * (2 ** i), cap)

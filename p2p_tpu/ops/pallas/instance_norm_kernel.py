@@ -22,9 +22,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _pick_h_block(h: int, w: int, c: int, budget_bytes: int = 2 * 1024 * 1024) -> int:
-    """Largest divisor of H whose (hb, W, C) fp32 block fits the VMEM budget."""
-    row_bytes = max(1, w * c * 4)
+def _pick_h_block(h: int, w: int, c: int, budget_bytes: int = 1024 * 1024) -> int:
+    """Largest divisor of H whose (hb, W, C) fp32 block fits the VMEM budget.
+
+    Sized against the PADDED tile: VMEM lays the (w, c) minor dims out in
+    (8, 128) tiles, so a narrow channel dim (e.g. the 32-channel local
+    enhancer at 1024×512) occupies 128 lanes regardless — ignoring that
+    padding overflowed scoped vmem (23.8M > 16M limit) on the pix2pixHD
+    preset. The budget covers the fp32 working copy; the bf16 in/out
+    blocks and double-buffering ride in the remaining headroom."""
+    padded_w = -(-w // 8) * 8
+    padded_c = -(-c // 128) * 128
+    row_bytes = max(1, padded_w * padded_c * 4)
     max_hb = max(1, budget_bytes // row_bytes)
     for hb in range(min(h, max_hb), 0, -1):
         if h % hb == 0:
