@@ -48,7 +48,12 @@ def psnr(target: jax.Array, pred: jax.Array, ref_buggy_scale: bool = False,
 
 
 def _uniform_window(x: jax.Array, win: int) -> jax.Array:
-    """Mean filter over win×win windows, per channel (NHWC), VALID."""
+    """Mean filter over win×win windows, per channel (NHWC), VALID.
+
+    precision=HIGHEST: the default conv precision runs bf16 passes on the
+    TPU MXU (and a reduced-precision path on CPU) — measured window-mean
+    errors of ~0.3 at the 0..255 scale, which explodes the E[x²]−μ²
+    moment terms in SSIM (values > 1 / < 0 at high PSNR)."""
     c = x.shape[-1]
     kernel = jnp.full((win, win, 1, 1), 1.0 / (win * win), jnp.float32)
     kernel = jnp.tile(kernel, (1, 1, 1, c))
@@ -56,6 +61,7 @@ def _uniform_window(x: jax.Array, win: int) -> jax.Array:
         x, kernel, (1, 1), "VALID",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=c,
+        precision=jax.lax.Precision.HIGHEST,
     )
 
 
@@ -69,14 +75,25 @@ def ssim(target: jax.Array, pred: jax.Array, ref_buggy_scale: bool = False,
     p = to_uint8_space(pred, ref_buggy_scale)
     L = 255.0
     c1, c2 = (0.01 * L) ** 2, (0.03 * L) ** 2
-    mu_t = _uniform_window(t, win)
-    mu_p = _uniform_window(p, win)
+    # Shifted moments: remove the per-image/channel mean before the
+    # second-moment windows. Variance and covariance are shift-invariant,
+    # and centering drops the 0..255 offset out of the E[x²]−μ² subtraction
+    # (catastrophic cancellation in fp32 once pred ≈ target); the luminance
+    # terms add the shift back exactly.
+    s_t = jnp.mean(t, axis=(1, 2), keepdims=True)
+    s_p = jnp.mean(p, axis=(1, 2), keepdims=True)
+    tc = t - s_t
+    pc = p - s_p
+    mu_tc = _uniform_window(tc, win)
+    mu_pc = _uniform_window(pc, win)
+    mu_t = mu_tc + s_t
+    mu_p = mu_pc + s_p
     # skimage uses unbiased covariance (ddof=1) via cov_norm = N/(N-1)
     n = win * win
     cov_norm = n / (n - 1.0)
-    var_t = cov_norm * (_uniform_window(t * t, win) - mu_t**2)
-    var_p = cov_norm * (_uniform_window(p * p, win) - mu_p**2)
-    cov = cov_norm * (_uniform_window(t * p, win) - mu_t * mu_p)
+    var_t = cov_norm * (_uniform_window(tc * tc, win) - mu_tc**2)
+    var_p = cov_norm * (_uniform_window(pc * pc, win) - mu_pc**2)
+    cov = cov_norm * (_uniform_window(tc * pc, win) - mu_tc * mu_pc)
     num = (2 * mu_t * mu_p + c1) * (2 * cov + c2)
     den = (mu_t**2 + mu_p**2 + c1) * (var_t + var_p + c2)
     smap = num / den
