@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--lamb", type=float, default=None,
                    help="L1 weight (dead in the reference — Q3; live here)")
+    p.add_argument("--eval_fid", action="store_true", default=None,
+                   help="compute FID (VFID for video presets) per eval epoch "
+                        "from VGG19 features; the feature source "
+                        "(pretrained npz vs random init) is reported")
+    p.add_argument("--scan_steps", type=int, default=None,
+                   help="train steps fused into one lax.scan dispatch "
+                        "(amortizes host/tunnel latency; metrics are still "
+                        "logged per step)")
     return p
 
 
@@ -91,7 +99,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                 test_batch_size=args.test_batch_size, threads=args.threads,
                 augment=args.augment)
     train = over(train, nepoch=args.nepoch, epoch_count=args.epoch_count,
-                 epoch_save=args.epochsave, seed=args.seed)
+                 epoch_save=args.epochsave, seed=args.seed,
+                 eval_fid=args.eval_fid, scan_steps=args.scan_steps)
     if args.mesh is not None:
         from p2p_tpu.core.mesh import MeshSpec
 

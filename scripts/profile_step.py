@@ -1,0 +1,157 @@
+"""Profile a preset's train step on the current device and summarize it.
+
+Captures a ``jax.profiler`` trace of K scanned steps, then parses the
+Perfetto JSON the TPU runtime emits and aggregates device time two ways:
+
+1. per network and direction (forward / backward, via the ``jvp`` /
+   ``transpose(jvp)`` markers XLA leaves in ``tf_op`` metadata), with
+   achieved TFLOP/s and HBM GB/s per group;
+2. the top-N single kernels with their efficiency, so memory-bound or
+   badly-tiled fusions stand out.
+
+This is the workflow that found the one-pass BatchNorm win and the
+pix2pixHD VMEM overflow — packaged so any preset change can be profiled
+with one command:
+
+    python scripts/profile_step.py --preset facades --bs 64 --steps 8
+    python scripts/profile_step.py --preset pix2pixhd   # native dims
+
+The full trace stays in --logdir for TensorBoard/XProf/Perfetto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(args) -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.models.vgg import load_vgg19_params
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_multi_train_step
+    from p2p_tpu.utils.profiling import trace
+
+    cfg = get_preset(args.preset)
+    h = args.img or cfg.data.image_size
+    w = args.img or cfg.data.image_width
+    bs = args.bs or cfg.data.batch_size
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, batch_size=bs, image_size=h, image_width=w))
+    dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
+
+    host = synthetic_batch(batch_size=bs, size=h, width=w,
+                           bits=cfg.model.quant_bits)
+    single = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
+    state = create_train_state(cfg, jax.random.key(0), single,
+                               train_dtype=dtype)
+    vgg = (load_vgg19_params(jnp.bfloat16 if dtype is not None
+                             else jnp.float32)
+           if (cfg.loss.lambda_vgg > 0 or cfg.loss.lambda_style > 0)
+           else None)
+    step = build_multi_train_step(cfg, vgg, train_dtype=dtype)
+    batches = {k: jnp.asarray(np.broadcast_to(v, (args.steps,) + v.shape)
+                              .copy(), jnp.float32) for k, v in host.items()}
+    state, m = step(state, batches)          # compile
+    float(m["loss_g"][-1])
+    with trace(args.logdir):
+        state, m = step(state, batches)
+        float(m["loss_g"][-1])               # fence via host fetch
+    traces = sorted(glob.glob(os.path.join(
+        args.logdir, "plugins/profile/*/*.trace.json.gz")))
+    if not traces:
+        raise SystemExit(f"no trace written under {args.logdir}")
+    return traces[-1]
+
+
+def summarize(path: str, steps: int, top: int = 12) -> None:
+    ev = json.load(gzip.open(path))
+    events = ev["traceEvents"]
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev_pids = {p for p, n in pids.items() if "TPU" in n or "GPU" in n}
+    if not dev_pids:  # CPU runs label differently; fall back to all pids
+        dev_pids = set(pids)
+
+    group = collections.Counter()
+    gflops = collections.Counter()
+    gbytes = collections.Counter()
+    kernels = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        a = e.get("args")
+        if not isinstance(a, dict):
+            continue
+        top_op = a.get("tf_op", "")
+        if not top_op:
+            continue
+        m = re.search(r"(jvp|transpose\(jvp)\(([A-Za-z0-9_]+)\)", top_op)
+        key = (m.group(2) +
+               (":bwd" if m.group(1).startswith("transpose") else ":fwd")
+               ) if m else "other"
+        dur = e["dur"]
+        group[key] += dur
+        gflops[key] += int(a.get("model_flops", 0) or 0)
+        gbytes[key] += int(a.get("raw_bytes_accessed", 0) or 0)
+        name = e["name"]
+        if name not in kernels:
+            kernels[name] = [0, 0, 0, top_op]
+        kernels[name][0] += dur
+        kernels[name][1] += int(a.get("model_flops", 0) or 0)
+        kernels[name][2] += int(a.get("raw_bytes_accessed", 0) or 0)
+
+    total = sum(group.values())
+    print(f"\ndevice time {total / 1e3:.1f} ms over {steps} steps "
+          f"({total / steps / 1e3:.2f} ms/step)")
+    print(f"{'group':34s} {'ms':>9s} {'%':>6s} {'TF/s':>7s} {'GB/s':>7s}")
+    for k, d in group.most_common():
+        tf = gflops[k] / d / 1e6 if d else 0.0
+        gb = gbytes[k] / d / 1e3 if d else 0.0
+        print(f"{k:34s} {d / 1e3:9.2f} {100 * d / total:6.1f} "
+              f"{tf:7.1f} {gb:7.0f}")
+    print(f"\ntop {top} kernels (summed over steps):")
+    for name, (d, f, b, op) in sorted(
+            kernels.items(), key=lambda kv: -kv[1][0])[:top]:
+        tf = f / d / 1e6 if d else 0.0
+        gb = b / d / 1e3 if d else 0.0
+        tail = op.split("closed_call/")[-1][:60]
+        print(f"{d / 1e3:8.2f} ms {tf:6.1f} TF/s {gb:5.0f} GB/s  "
+              f"{name[:28]:28s} {tail}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--preset", default="facades")
+    ap.add_argument("--bs", type=int, default=None,
+                    help="batch size (default: preset)")
+    ap.add_argument("--img", type=int, default=None,
+                    help="square image override (default: preset dims)")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="scanned steps inside the traced dispatch")
+    ap.add_argument("--logdir", default="/tmp/p2p_tpu_profile")
+    ap.add_argument("--trace", default=None,
+                    help="summarize an existing trace.json.gz instead")
+    args = ap.parse_args()
+    path = args.trace or capture(args)
+    print(f"trace: {path}")
+    summarize(path, args.steps)
+
+
+if __name__ == "__main__":
+    main()

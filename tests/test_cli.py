@@ -32,6 +32,17 @@ def test_config_from_flags_preset_plus_overrides():
     assert cfg.loss.lambda_vgg == 10.0
 
 
+def test_config_from_flags_eval_knobs():
+    cfg = config_from_flags(build_parser().parse_args(
+        ["--eval_fid", "--scan_steps", "4"]))
+    assert cfg.train.eval_fid is True
+    assert cfg.train.scan_steps == 4
+    # unset flags keep preset defaults
+    cfg = config_from_flags(build_parser().parse_args([]))
+    assert cfg.train.eval_fid is False
+    assert cfg.train.scan_steps == 1
+
+
 def test_config_from_flags_defaults_match_reference():
     cfg = config_from_flags(build_parser().parse_args([]))
     # reference train.py defaults: lr=2e-4, beta1=0.5, lambda policy
