@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["deconv", "resize"])
     p.add_argument("--metrics", action="store_true",
                    help="also print mean/max PSNR+SSIM vs the targets")
+    p.add_argument("--pool_size", type=int, default=None,
+                   help="pool size the checkpoint was TRAINED with — needed "
+                        "to rebuild the state template for full-state "
+                        "restore (like --ndf)")
     return p
 
 
@@ -73,7 +77,8 @@ def main(argv=None) -> int:
                 test_batch_size=args.batch_size, image_size=args.image_size)
     model = over(cfg.model, ngf=args.ngf, ndf=args.ndf,
                  n_blocks=args.n_blocks, upsample_mode=args.upsample_mode)
-    cfg = dataclasses.replace(cfg, data=data, model=model,
+    train = over(cfg.train, pool_size=args.pool_size)
+    cfg = dataclasses.replace(cfg, data=data, model=model, train=train,
                               name=args.name or cfg.name)
     if cfg.data.n_frames > 1:
         return _video_main(args, cfg)

@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--lamb", type=float, default=None,
                    help="L1 weight (dead in the reference — Q3; live here)")
+    p.add_argument("--pool_size", type=int, default=None,
+                   help="historical-fake pool fed to D (reference "
+                        "ImagePool(0) = passthrough); >0 enables a "
+                        "device-side ring buffer. Image presets only — "
+                        "the video step has no pool")
     p.add_argument("--eval_fid", action="store_true", default=None,
                    help="compute FID (VFID for video presets) per eval epoch "
                         "from VGG19 features; the feature source "
@@ -100,7 +105,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                 augment=args.augment)
     train = over(train, nepoch=args.nepoch, epoch_count=args.epoch_count,
                  epoch_save=args.epochsave, seed=args.seed,
-                 eval_fid=args.eval_fid, scan_steps=args.scan_steps)
+                 eval_fid=args.eval_fid, scan_steps=args.scan_steps,
+                 pool_size=args.pool_size)
     if args.mesh is not None:
         from p2p_tpu.core.mesh import MeshSpec
 

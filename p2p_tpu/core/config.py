@@ -135,6 +135,11 @@ class TrainConfig:
     # VFID (Fréchet distance over pooled VGG19 taps) during eval — the
     # north-star quality metric; needs lambda_vgg>0 or a VGG asset loaded.
     eval_fid: bool = False
+    # Historical-fake pool fed to D's fake branch (reference ImagePool,
+    # instantiated size 0 = passthrough at train.py:248). pool_size > 0
+    # enables a DEVICE-side ring buffer in TrainState (utils.pool.
+    # device_pool_query) holding (real_a ‖ fake_b) pairs.
+    pool_size: int = 0
     # jax_debug_nans: first NaN-producing primitive raises with location.
     debug_nans: bool = False
 

@@ -37,6 +37,10 @@ class TrainState(struct.PyTreeNode):
     params_c: Any
     batch_stats_c: Any
     opt_c: Optional[optax.OptState]
+    # device-side historical-fake pool (TrainConfig.pool_size > 0);
+    # None keeps the pytree structure unchanged when disabled
+    pool: Optional[jax.Array] = None
+    pool_n: Optional[jax.Array] = None
 
 
 def make_optimizers(cfg: Config, steps_per_epoch: int):
@@ -89,6 +93,14 @@ def create_train_state(
         batch_stats_c = vc.get("batch_stats", {})
         opt_c_state = opt_c.init(params_c)
 
+    pool = pool_n = None
+    if cfg.train.pool_size > 0:
+        pool = jnp.zeros(
+            (cfg.train.pool_size,) + pair.shape[1:],
+            train_dtype or jnp.float32,
+        )
+        pool_n = jnp.zeros((), jnp.int32)
+
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         lr_scale=jnp.ones((), jnp.float32),
@@ -101,4 +113,6 @@ def create_train_state(
         params_c=params_c,
         batch_stats_c=batch_stats_c,
         opt_c=opt_c_state,
+        pool=pool,
+        pool_n=pool_n,
     )

@@ -125,11 +125,27 @@ def build_train_step(
         )
         bs_g1 = vg1["batch_stats"]
 
+        # historical-fake pool (reference train.py:307: the CONCAT pair is
+        # pooled into D's fake branch; size 0 = passthrough). Device-side
+        # ring buffer in TrainState — no host round-trip inside the scan.
+        fake_pair = _concat_pair(real_a, jax.lax.stop_gradient(fake_b_primal))
+        pool1, pool_n1 = state.pool, state.pool_n
+        if cfg.train.pool_size > 0 and state.pool is not None:
+            from p2p_tpu.utils.pool import device_pool_query
+
+            pool_rng = jax.random.fold_in(
+                jax.random.key(cfg.train.seed ^ 0x705501), state.step
+            )
+            fake_pair, pool1, pool_n1 = device_pool_query(
+                state.pool, state.pool_n, fake_pair, pool_rng
+            )
+            fake_pair = jax.lax.stop_gradient(fake_pair)
+
         # ---- 2. discriminator loss --------------------------------------
         def loss_d_fn(params_d):
             pred_fake, s1 = d_fwd(
                 params_d, state.spectral_d,
-                _concat_pair(real_a, jax.lax.stop_gradient(fake_b_primal)),
+                fake_pair,
             )
             pred_real, s2 = d_fwd(
                 params_d, s1["spectral"], _concat_pair(real_a, real_b)
@@ -240,6 +256,8 @@ def build_train_step(
             params_c=params_c1,
             batch_stats_c=bs_c1,
             opt_c=opt_c1,
+            pool=pool1,
+            pool_n=pool_n1,
         )
         metrics = {
             "loss_d": loss_d.astype(jnp.float32),

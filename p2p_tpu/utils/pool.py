@@ -7,15 +7,17 @@ buffer until full, then with probability 0.5 it swaps with a random stored
 image (return the stored one, keep the new one) and with 0.5 passes
 through.
 
-Host-side by design: the pool is a training-data perturbation, not part of
-the differentiated graph — keep it out of jit and feed its output as the
-batch's fake image. NumPy arrays in, NumPy arrays out.
+Two implementations: the host-side ``ImagePool`` class (numpy, reference
+behavior for host-driven loops) and ``device_pool_query`` — the TPU-native
+form, a ring tensor carried in ``TrainState`` so the jitted/scanned train
+step never round-trips to the host (wired via ``TrainConfig.pool_size``).
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -43,3 +45,55 @@ class ImagePool:
             else:
                 out.append(img)
         return np.stack(out)
+
+
+def device_pool_query(pool, pool_n, pairs, rng):
+    """Jit-safe, device-resident pool step (the TPU-native ImagePool).
+
+    The reference's pool is a host-side python list (networks.py:64-91);
+    inside a jitted/scanned train step a host round-trip per iteration
+    would serialize the pipeline, so the buffer lives in ``TrainState``
+    as a ring tensor instead.
+
+    pool:   (P, H, W, C) stored pairs (real_a ‖ fake_b, like train.py:307)
+    pool_n: () int32 — slots filled so far
+    pairs:  (N, H, W, C) incoming fake pairs
+    rng:    per-step key
+
+    Per sample, matching ImagePool.query semantics: while not full, store
+    and pass through; once full, with p=0.5 swap with a uniformly random
+    stored pair (return the stored one, keep the new one), else pass
+    through. Returns (pairs_for_D, new_pool, new_pool_n).
+    """
+    import jax
+
+    p_size = pool.shape[0]
+    n = pairs.shape[0]
+    k_idx, k_swap = jax.random.split(rng)
+    offs = pool_n + jnp.arange(n, dtype=jnp.int32)
+    not_full = offs < p_size
+    # Swap targets draw only from slots already filled (earlier fill-phase
+    # samples of this batch included): a batch crossing the fill boundary
+    # must never hand D an uninitialized all-zeros pair. Modulo draw — the
+    # tiny non-uniformity is irrelevant for the pool's purpose.
+    filled = jnp.minimum(offs, p_size)
+    rand_idx = (
+        jax.random.randint(k_idx, (n,), 0, p_size, jnp.int32)
+        % jnp.maximum(filled, 1)
+    )
+    swap = jax.random.uniform(k_swap, (n,)) > 0.5
+
+    write_idx = jnp.where(not_full, jnp.minimum(offs, p_size - 1), rand_idx)
+    do_write = not_full | swap
+    use_stored = (~not_full) & swap
+
+    stored = pool[write_idx].astype(pairs.dtype)
+    out = jnp.where(use_stored[:, None, None, None], stored, pairs)
+    # Scatter ONLY the writing samples (mode='drop' on an out-of-bounds
+    # index): a passthrough sample must not write a stale copy back over a
+    # swapping sample's store when their indices collide. Two swaps to the
+    # same slot remain last-wins (both are valid incoming pairs).
+    safe_idx = jnp.where(do_write, write_idx, p_size)
+    new_pool = pool.at[safe_idx].set(pairs.astype(pool.dtype), mode="drop")
+    new_n = jnp.minimum(pool_n + jnp.sum(not_full.astype(jnp.int32)), p_size)
+    return out, new_pool, new_n

@@ -12,6 +12,7 @@ from p2p_tpu.core.config import (
     OptimConfig,
     ParallelConfig,
     TrainConfig,
+    get_preset,
 )
 from p2p_tpu.core.mesh import MeshSpec
 from p2p_tpu.data.synthetic import synthetic_batch
@@ -244,3 +245,74 @@ def test_multi_step_scan_matches_sequential():
                       jax.tree_util.tree_leaves(state_b.params_g)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                    rtol=1e-3, atol=8 * 2e-4)
+
+
+def test_device_pool_semantics():
+    """device_pool_query matches reference ImagePool behavior: fill phase
+    passes through and stores; once full, outputs are either the incoming
+    pair or a previously stored one, swaps happen with p≈0.5, and the
+    buffer only ever contains previously-seen pairs."""
+    from p2p_tpu.utils.pool import device_pool_query
+
+    P, n_steps = 4, 64
+    pool = jnp.zeros((P, 2, 2, 1), jnp.float32)
+    pool_n = jnp.zeros((), jnp.int32)
+    stored = set()
+    swaps = 0
+    q = jax.jit(device_pool_query)
+    for i in range(n_steps):
+        incoming = float(i + 1)
+        pair = jnp.full((1, 2, 2, 1), incoming)
+        out, pool, pool_n = q(pool, pool_n, pair, jax.random.key(i))
+        val = float(out[0, 0, 0, 0])
+        if i < P:
+            assert val == incoming       # fill phase: passthrough + store
+            assert int(pool_n) == i + 1
+            stored.add(incoming)
+        else:
+            assert int(pool_n) == P
+            if val != incoming:          # swap: returned pair must have
+                assert val in stored     # been stored earlier; buffer now
+                stored.discard(val)      # holds the incoming pair instead
+                stored.add(incoming)
+                swaps += 1
+            # else passthrough: buffer untouched
+    assert 0.25 < swaps / (n_steps - P) < 0.75  # p≈0.5 swap rate
+
+
+def test_train_step_with_pool_enabled(tmp_path):
+    """pool_size > 0 threads the ring buffer through the jitted step, the
+    Orbax checkpoint round-trip, and a restore into a template rebuilt the
+    way cli.infer does (preset + pool_size flag)."""
+    import dataclasses
+
+    cfg = get_preset("facades")
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=32),
+        train=dataclasses.replace(cfg.train, pool_size=8),
+    )
+    batch = {
+        "input": jnp.asarray(
+            np.random.default_rng(0).uniform(-1, 1, (2, 32, 32, 3)),
+            jnp.float32),
+        "target": jnp.asarray(
+            np.random.default_rng(1).uniform(-1, 1, (2, 32, 32, 3)),
+            jnp.float32),
+    }
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    assert state.pool.shape == (8, 32, 32, 6)
+    step = build_train_step(cfg)
+    state, _ = step(state, batch)
+    state, _ = step(state, batch)
+    assert int(state.pool_n) == 4  # two steps x bs2 fill four slots
+    assert float(jnp.abs(state.pool[:4]).sum()) > 0
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(str(tmp_path))
+    ckpt.save(2, state, wait=True)
+    template = create_train_state(cfg, jax.random.key(1), batch)
+    restored = ckpt.restore(template, 2)
+    np.testing.assert_array_equal(np.asarray(restored.pool),
+                                  np.asarray(state.pool))
+    assert int(restored.pool_n) == 4
