@@ -22,10 +22,12 @@ Stateful-op functionalization: BatchNorm stats thread through
 reference); spectral-norm u/v thread through ``spectral`` in the
 reference's call order (D-fake, D-real, D-for-G = 3 power iterations/step).
 
-TPU notes: the three D forwards and two G forwards contain two identical
-subgraphs (fake_b's forward, D(real_a‖fake_b)) which XLA CSEs away — the
-functional rewrite costs nothing over the reference's tensor reuse. The
-whole step is one XLA program: no host round-trips between "optimizers".
+TPU notes: the generator runs ONCE per step via an explicit ``jax.vjp``
+(the loss graphs consume the primal value; G's gradient is the VJP of the
+d(loss_g)/d(fake_b) cotangent), and the two D(fake) forwards are identical
+subgraphs XLA CSEs away — the functional rewrite costs nothing over the
+reference's tensor reuse. The whole step is one XLA program: no host
+round-trips between "optimizers".
 """
 
 from __future__ import annotations
@@ -71,13 +73,14 @@ def build_train_step(
 
     use_dropout = cfg.model.use_dropout
 
-    # NOTE on residual policy: wrapping these forwards in jax.checkpoint with
-    # save_only_these_names('conv_out', 'norm_stats') was measured SLOWER
-    # (52→67 ms/step @ bs64 on v5e): the remat barriers block XLA's CSE of
-    # the duplicated G/D forwards (fake_b primal vs loss graph, D(fake) in
-    # D-loss vs G-loss), re-adding ~1.2 TF/step — more than the saved
-    # residual traffic. The checkpoint_name tags remain in the models for
-    # the big-activation presets, where remat is on anyway.
+    # NOTE on residual policy: wrapping these forwards in jax.checkpoint
+    # with save_only_these_names('conv_out', 'norm_stats') was measured
+    # SLOWER (52→67 ms/step @ bs64 on v5e; measured on the pre-vjp
+    # structure): the remat barriers block XLA's CSE of the step's
+    # remaining duplicated subgraph — D(fake) in the D-loss vs the G-loss
+    # (shared whenever pool_size=0) — and the recompute costs more than
+    # the saved residual traffic. The checkpoint_name tags remain in the
+    # models for the big-activation presets, where remat is useful anyway.
     def g_fwd(params, bstats, x, rng=None):
         rngs = {"dropout": rng} if (use_dropout and rng is not None) else None
         return g.apply(
@@ -112,18 +115,27 @@ def build_train_step(
 
         g_input = jax.lax.stop_gradient(compressed)
 
-        # per-step dropout noise (pix2pix's noise source); the SAME key in
-        # the primal and loss-graph G forwards keeps them CSE-identical
+        # per-step dropout noise (pix2pix's noise source)
         drop_rng = (
             jax.random.fold_in(jax.random.key(cfg.train.seed), state.step)
             if use_dropout else None
         )
 
-        # primal G forward (value shared with both loss graphs via CSE)
-        fake_b_primal, vg1 = g_fwd(
-            state.params_g, state.batch_stats_g, g_input, drop_rng
+        # ONE generator forward via explicit jax.vjp: every loss graph
+        # consumes the primal VALUE, and G's parameter gradient is pulled
+        # through g_vjp with the cotangent d(loss_g)/d(fake_b). The earlier
+        # structure (a primal call + value_and_grad of a second g_fwd)
+        # relied on XLA CSE to dedupe the two forwards — which structurally
+        # FAILS for instance-norm generators (the jvp rewrite of the
+        # var/mean primal diverges after the first norm), silently doubling
+        # the cityscapes/pix2pixHD generator cost.
+        def g_primal(params_g):
+            out, vg = g_fwd(params_g, state.batch_stats_g, g_input, drop_rng)
+            return out, vg["batch_stats"]
+
+        fake_b_primal, g_vjp, bs_g1 = jax.vjp(
+            g_primal, state.params_g, has_aux=True
         )
-        bs_g1 = vg1["batch_stats"]
 
         # historical-fake pool (reference train.py:307: the CONCAT pair is
         # pooled into D's fake branch; size 0 = passthrough). Device-side
@@ -161,9 +173,9 @@ def build_train_step(
         )(state.params_d)
         pred_real = jax.tree_util.tree_map(jax.lax.stop_gradient, pred_real)
 
-        # ---- 3. generator loss ------------------------------------------
-        def loss_g_fn(params_g):
-            fake_b, _ = g_fwd(params_g, state.batch_stats_g, g_input, drop_rng)
+        # ---- 3. generator loss (differentiated wrt fake_b; chain rule
+        # through g_vjp gives the params_g gradient) ----------------------
+        def loss_g_fn(fake_b):
             pred_fake_g, s3 = d_fwd(
                 jax.lax.stop_gradient(state.params_d),
                 spectral1,
@@ -204,9 +216,10 @@ def build_train_step(
                 total = total + l_l1
             return total, (s3["spectral"], parts)
 
-        (loss_g, (spectral2, g_parts)), grads_g = jax.value_and_grad(
+        (loss_g, (spectral2, g_parts)), grad_fake = jax.value_and_grad(
             loss_g_fn, has_aux=True
-        )(state.params_g)
+        )(fake_b_primal)
+        (grads_g,) = g_vjp(grad_fake)
 
         # ---- 4. apply G then D updates (reference order) ----------------
         # lr_scale: Adam updates are linear in lr, so the host-driven
