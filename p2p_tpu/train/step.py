@@ -25,8 +25,9 @@ reference's call order (D-fake, D-real, D-for-G = 3 power iterations/step).
 TPU notes: the generator runs ONCE per step via an explicit ``jax.vjp``
 (the loss graphs consume the primal value; G's gradient is the VJP of the
 d(loss_g)/d(fake_b) cotangent), and the two D(fake) forwards are identical
-subgraphs XLA CSEs away — the functional rewrite costs nothing over the
-reference's tensor reuse. The whole step is one XLA program: no host
+subgraphs XLA CSEs away when D is stateless (spectral norm inserts a
+power-iteration state between them) — the functional rewrite costs nothing
+over the reference's tensor reuse. The whole step is one XLA program: no host
 round-trips between "optimizers".
 """
 
@@ -78,7 +79,9 @@ def build_train_step(
     # SLOWER (52→67 ms/step @ bs64 on v5e; measured on the pre-vjp
     # structure): the remat barriers block XLA's CSE of the step's
     # remaining duplicated subgraph — D(fake) in the D-loss vs the G-loss
-    # (shared whenever pool_size=0) — and the recompute costs more than
+    # (shared only when pool_size=0 AND spectral norm is off; with spectral
+    # norm the two run at different u/v states and cannot CSE) — and the
+    # recompute costs more than
     # the saved residual traffic. The checkpoint_name tags remain in the
     # models for the big-activation presets, where remat is useful anyway.
     def g_fwd(params, bstats, x, rng=None):

@@ -153,8 +153,14 @@ def build_video_train_step(
             jax.random.fold_in(jax.random.key(cfg.train.seed), state.step)
             if use_dropout else None
         )
-        fake_f, bs_g = g_frames(state.params_g, state.batch_stats_g, a_f,
-                                drop_rng)
+        # ONE generator forward via explicit jax.vjp (see train/step.py:
+        # CSE of a duplicated forward structurally fails for instance-norm
+        # generators, the vid2vid default).
+        def g_primal(params_g):
+            out, bs = g_frames(params_g, state.batch_stats_g, a_f, drop_rng)
+            return out, bs
+
+        fake_f, g_vjp, bs_g = jax.vjp(g_primal, state.params_g, has_aux=True)
         fake_clip = fake_f.reshape(real_b.shape)
 
         # ---- spatial D ----------------------------------------------------
@@ -199,9 +205,9 @@ def build_video_train_step(
             jax.lax.stop_gradient, pred_real_t
         )
 
-        # ---- G ------------------------------------------------------------
-        def loss_g_fn(params_g):
-            fake, _ = g_frames(params_g, state.batch_stats_g, a_f, drop_rng)
+        # ---- G (differentiated wrt the fake frames; chain rule through
+        # g_vjp gives the params_g gradient) --------------------------------
+        def loss_g_fn(fake):
             clip = fake.reshape(real_b.shape)
             pred_fake_g, s3 = d_fwd(
                 jax.lax.stop_gradient(state.params_d), spectral1,
@@ -244,9 +250,10 @@ def build_video_train_step(
                 total = total + l_l1
             return total, (s3["spectral"], t3["spectral"], parts)
 
-        (loss_g, (spectral2, spectral_t2, g_parts)), grads_g = jax.value_and_grad(
-            loss_g_fn, has_aux=True
-        )(state.params_g)
+        (loss_g, (spectral2, spectral_t2, g_parts)), grad_fake = (
+            jax.value_and_grad(loss_g_fn, has_aux=True)(fake_f)
+        )
+        (grads_g,) = g_vjp(grad_fake)
 
         scale = state.lr_scale.astype(jnp.float32)
         scale_tree = lambda ups: jax.tree_util.tree_map(  # noqa: E731
