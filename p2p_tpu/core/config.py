@@ -51,7 +51,11 @@ class ModelConfig:
     # threads a per-step dropout rng when this is on.
     use_dropout: bool = False
     # U-Net decoder upsampling: "deconv" (ConvTranspose k4 s2 — torch
-    # parity, ~2x fewer decoder FLOPs) or "resize" (nearest + conv k3).
+    # parameter layout; the default), "subpixel" (conv k2s1 +
+    # depth-to-space — same operator family/FLOPs, but the shifted
+    # interleave costs an extra memory-bound pass per level: measured
+    # SLOWER than deconv on v5e, kept as an option), or "resize"
+    # (nearest + conv k3).
     upsample_mode: str = "deconv"
     init_type: str = "normal"   # normal | xavier | kaiming | orthogonal
     init_gain: float = 0.02

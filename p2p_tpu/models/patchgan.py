@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from p2p_tpu.ops.activations import leaky_relu_y
 from p2p_tpu.ops.conv import normal_init, save_conv_out
 from p2p_tpu.ops.spectral_norm import SpectralConv
 
@@ -77,7 +78,7 @@ class NLayerDiscriminator(nn.Module):
         feats = []
         nf = self.ndf
         y = _PlainConv(nf, stride=2, dtype=self.dtype)(x)
-        y = nn.leaky_relu(y, negative_slope=0.2)
+        y = leaky_relu_y(y, 0.2)
         feats.append(y)
 
         def inner(y, features, stride):
@@ -87,7 +88,7 @@ class NLayerDiscriminator(nn.Module):
                 )(y)
             else:
                 y = _PlainConv(features, stride=stride, dtype=self.dtype)(y)
-            return nn.leaky_relu(y, negative_slope=0.2)
+            return leaky_relu_y(y, 0.2)
 
         for _ in range(1, self.n_layers):
             nf = min(nf * 2, 512)

@@ -1,13 +1,24 @@
-"""Activations the reference uses that flax lacks.
+"""Activations the reference uses that flax lacks, plus residual-lean
+variants.
 
 ``nn.PReLU()`` in torch carries ONE learned scalar (init 0.25) shared over
 all channels; the reference's ExpandNetwork even shares a single instance
 across every call site (networks.py:452,500-520), so the module here is
 instantiated once and reused to keep parameter-count parity.
+
+``leaky_relu_y`` / ``relu_y`` / ``tanh_y`` are custom-VJP activations whose
+backward is computed FROM THE OUTPUT instead of the input: for
+sign-preserving activations ``y>0 ⟺ x>0`` (and ``tanh' = 1-y²``), so the
+pre-activation tensor need not be kept as a residual — the output already
+lives in HBM as the next conv's saved input. On the 256² pix2pix step the
+default (input-saved) rule makes XLA keep BOTH the norm output and the
+activation output per block; these variants drop the former and cut
+backward residual traffic.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -23,3 +34,74 @@ class PReLU(nn.Module):
 
 def leaky_relu(x, slope: float = 0.2):
     return nn.leaky_relu(x, negative_slope=slope)
+
+
+@jax.custom_vjp
+def _leaky_relu_y(x, slope):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def _leaky_fwd(x, slope):
+    y = _leaky_relu_y(x, slope)
+    return y, (y, slope)
+
+
+def _leaky_bwd(res, ct):
+    y, slope = res
+    return (jnp.where(y >= 0, ct, slope * ct), None)
+
+
+_leaky_relu_y.defvjp(_leaky_fwd, _leaky_bwd)
+
+
+def leaky_relu_y(x, slope: float = 0.2):
+    """LeakyReLU whose VJP mask comes from the output (slope>0 preserves
+    sign, so ``y>=0 ⟺ x>=0``; at exactly 0 both rules agree).
+
+    The output-mask rule requires a sign-preserving slope — for slope<=0
+    use :func:`relu_y` / plain ``nn.leaky_relu`` instead.
+    """
+    if slope <= 0:
+        raise ValueError(
+            f"leaky_relu_y needs slope > 0 (got {slope}); the output-based "
+            "gradient mask is only valid for sign-preserving activations"
+        )
+    return _leaky_relu_y(x, slope)
+
+
+@jax.custom_vjp
+def relu_y(x):
+    """ReLU whose VJP mask comes from the output (grad 0 at x==0,
+    matching ``jnp.where(x > 0)`` a.e.)."""
+    return jnp.maximum(x, 0)
+
+
+def _relu_fwd(x):
+    y = relu_y(x)
+    return y, y
+
+
+def _relu_bwd(y, ct):
+    return (jnp.where(y > 0, ct, jnp.zeros_like(ct)),)
+
+
+relu_y.defvjp(_relu_fwd, _relu_bwd)
+
+
+@jax.custom_vjp
+def tanh_y(x):
+    """tanh whose VJP uses ``1 - y²`` from the output."""
+    return jnp.tanh(x)
+
+
+def _tanh_fwd(x):
+    y = tanh_y(x)
+    return y, y
+
+
+def _tanh_bwd(y, ct):
+    one = jnp.ones((), y.dtype)
+    return (ct * (one - y * y),)
+
+
+tanh_y.defvjp(_tanh_fwd, _tanh_bwd)

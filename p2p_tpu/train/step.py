@@ -19,16 +19,35 @@ SURVEY §3.1) with its live bugs fixed by design:
 
 Stateful-op functionalization: BatchNorm stats thread through
 ``batch_stats`` (C once, G twice per step — same update count as the
-reference); spectral-norm u/v thread through ``spectral`` in the
-reference's call order (D-fake, D-real, D-for-G = 3 power iterations/step).
+reference); spectral-norm u/v thread through ``spectral``.
 
-TPU notes: the generator runs ONCE per step via an explicit ``jax.vjp``
-(the loss graphs consume the primal value; G's gradient is the VJP of the
-d(loss_g)/d(fake_b) cotangent), and the two D(fake) forwards are identical
-subgraphs XLA CSEs away when D is stateless (spectral norm inserts a
-power-iteration state between them) — the functional rewrite costs nothing
-over the reference's tensor reuse. The whole step is one XLA program: no host
-round-trips between "optimizers".
+TPU notes — single-forward structure. BOTH expensive forwards run exactly
+once per step via explicit ``jax.vjp``:
+
+- **G** runs once; every loss graph consumes the primal value and G's
+  parameter gradient is the VJP of the d(loss_g)/d(fake_b) cotangent.
+- **D(fake)** runs once (the reference runs it twice: train.py:308 for the
+  D loss, train.py:336 for the G loss — 3 full multiscale-D forwards/step
+  counting D(real)). Here one ``jax.vjp`` over ``(params_d, fake_pair) →
+  pred_fake`` serves both: the D-loss cotangent is pulled back to the
+  *params* slot (the pair cotangent is dead code XLA removes — exactly the
+  reference's ``fake_b.detach()``), and the G-loss cotangent is pulled back
+  to the *pair* slot (the params cotangent dies — the reference's
+  ``zero_grad`` before the D step). The VJP's linearity makes the two
+  pulls independent; the residuals are shared, so only the cheap
+  activation-gradient chain runs twice, never the forward.
+
+Documented deviation: with one D(fake) forward the spectral-norm power
+iteration advances 2× per step (fake, real) instead of the reference's 3×
+(networks.py:580-582), and the G-side GAN loss sees the u/v state of the
+step's first iteration rather than its third. Power iteration tracks the
+same principal singular vector either way; only its warm-up rate changes.
+When the historical-fake pool is active (``pool_size > 0``) the D-loss pair
+differs from the G-loss pair and the step falls back to the reference's
+3-forward structure.
+
+The whole step is one XLA program: no host round-trips between
+"optimizers".
 """
 
 from __future__ import annotations
@@ -56,6 +75,48 @@ def _concat_pair(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.concatenate([a, b], axis=-1)
 
 
+def single_forward_d_losses(d_apply, spectral0, params_d, fake_pair,
+                            real_pair, gan_mode: str):
+    """ONE D(fake) forward whose vjp serves both the D loss and (later) the
+    G loss — the "single-forward structure" of the module docstring, shared
+    by the image step (spatial D) and the video step (spatial + temporal D).
+
+    ``d_apply(params, spectral, x) -> (preds, mutated_vars)`` is the
+    discriminator apply fn. Returns ``(loss_d, grads_d, pred_fake,
+    pred_real, spectral2, pull)`` where ``pull(ct_pred) -> cotangent wrt
+    fake_pair`` re-uses the fake forward's residuals (its params cotangent
+    is dead code XLA removes — the reference's zero_grad before the D
+    step), and ``spectral2`` is the u/v state after the fake→real forward
+    chain (2 power iterations per step; deviation documented above).
+    """
+    def fake_primal(params, pair):
+        pred, s1 = d_apply(params, spectral0, pair)
+        return pred, s1["spectral"]
+
+    pred_fake, d_vjp, spectral_s1 = jax.vjp(
+        fake_primal, params_d, fake_pair, has_aux=True
+    )
+    loss_fake, ct_fake = jax.value_and_grad(
+        lambda p: 0.5 * gan_loss(p, False, gan_mode)
+    )(pred_fake)
+    gd_fake = d_vjp(ct_fake)[0]  # pair cotangent dead → DCE
+
+    def real_fn(params):
+        pred_real, s2 = d_apply(params, spectral_s1, real_pair)
+        loss = 0.5 * gan_loss(pred_real, True, gan_mode)
+        return loss, (s2["spectral"], pred_real)
+
+    (loss_real, (spectral2, pred_real)), gd_real = jax.value_and_grad(
+        real_fn, has_aux=True
+    )(params_d)
+    loss_d = loss_fake + loss_real
+    grads_d = jax.tree_util.tree_map(jnp.add, gd_fake, gd_real)
+    pred_real = jax.tree_util.tree_map(jax.lax.stop_gradient, pred_real)
+    return loss_d, grads_d, pred_fake, pred_real, spectral2, (
+        lambda ct: d_vjp(ct)[1]
+    )
+
+
 def build_train_step(
     cfg: Config,
     vgg_params: Optional[Any] = None,
@@ -77,13 +138,11 @@ def build_train_step(
     # NOTE on residual policy: wrapping these forwards in jax.checkpoint
     # with save_only_these_names('conv_out', 'norm_stats') was measured
     # SLOWER (52→67 ms/step @ bs64 on v5e; measured on the pre-vjp
-    # structure): the remat barriers block XLA's CSE of the step's
-    # remaining duplicated subgraph — D(fake) in the D-loss vs the G-loss
-    # (shared only when pool_size=0 AND spectral norm is off; with spectral
-    # norm the two run at different u/v states and cannot CSE) — and the
-    # recompute costs more than
-    # the saved residual traffic. The checkpoint_name tags remain in the
-    # models for the big-activation presets, where remat is useful anyway.
+    # structure): the recompute costs more than the saved residual
+    # traffic at these activation sizes. The checkpoint_name tags remain
+    # in the models for the big-activation presets, where remat is useful
+    # anyway. (The duplicated D(fake) subgraph that note originally
+    # discussed is now structurally gone — see the module docstring.)
     def g_fwd(params, bstats, x, rng=None):
         rngs = {"dropout": rng} if (use_dropout and rng is not None) else None
         return g.apply(
@@ -143,47 +202,15 @@ def build_train_step(
         # historical-fake pool (reference train.py:307: the CONCAT pair is
         # pooled into D's fake branch; size 0 = passthrough). Device-side
         # ring buffer in TrainState — no host round-trip inside the scan.
-        fake_pair = _concat_pair(real_a, jax.lax.stop_gradient(fake_b_primal))
+        use_pool = cfg.train.pool_size > 0 and state.pool is not None
         pool1, pool_n1 = state.pool, state.pool_n
-        if cfg.train.pool_size > 0 and state.pool is not None:
-            from p2p_tpu.utils.pool import device_pool_query
+        real_pair = _concat_pair(real_a, real_b)
+        in_c = real_a.shape[-1]
 
-            pool_rng = jax.random.fold_in(
-                jax.random.key(cfg.train.seed ^ 0x705501), state.step
-            )
-            fake_pair, pool1, pool_n1 = device_pool_query(
-                state.pool, state.pool_n, fake_pair, pool_rng
-            )
-            fake_pair = jax.lax.stop_gradient(fake_pair)
-
-        # ---- 2. discriminator loss --------------------------------------
-        def loss_d_fn(params_d):
-            pred_fake, s1 = d_fwd(
-                params_d, state.spectral_d,
-                fake_pair,
-            )
-            pred_real, s2 = d_fwd(
-                params_d, s1["spectral"], _concat_pair(real_a, real_b)
-            )
-            loss = 0.5 * (
-                gan_loss(pred_fake, False, L.gan_mode)
-                + gan_loss(pred_real, True, L.gan_mode)
-            )
-            return loss, (s2["spectral"], pred_real)
-
-        (loss_d, (spectral1, pred_real)), grads_d = jax.value_and_grad(
-            loss_d_fn, has_aux=True
-        )(state.params_d)
-        pred_real = jax.tree_util.tree_map(jax.lax.stop_gradient, pred_real)
-
-        # ---- 3. generator loss (differentiated wrt fake_b; chain rule
-        # through g_vjp gives the params_g gradient) ----------------------
-        def loss_g_fn(fake_b):
-            pred_fake_g, s3 = d_fwd(
-                jax.lax.stop_gradient(state.params_d),
-                spectral1,
-                _concat_pair(real_a, fake_b),
-            )
+        # G-side loss terms, shared by both step structures. ``pred_fake_g``
+        # is the multiscale D output on (real_a ‖ fake_b); differentiation
+        # wrt it routes the GAN + feature-matching cotangent back through D.
+        def g_losses(fake_b, pred_fake_g):
             l_gan = gan_loss(pred_fake_g, True, L.gan_mode, for_discriminator=False)
             parts = {"g_gan": l_gan}
             total = l_gan
@@ -212,16 +239,82 @@ def build_train_step(
                 parts["g_tv"] = l_tv
                 total = total + l_tv
             if L.lambda_l1 > 0:
+                # elementwise diff in the train dtype (bf16 cotangents),
+                # accumulation in f32 — halves the loss-side HBM traffic
+                # at 256²·bs128 vs an f32 elementwise chain.
                 l_l1 = jnp.mean(
-                    jnp.abs(fake_b.astype(jnp.float32) - real_b.astype(jnp.float32))
+                    jnp.abs(fake_b - real_b), dtype=jnp.float32
                 ) * L.lambda_l1
                 parts["g_l1"] = l_l1
                 total = total + l_l1
-            return total, (s3["spectral"], parts)
+            return total, parts
 
-        (loss_g, (spectral2, g_parts)), grad_fake = jax.value_and_grad(
-            loss_g_fn, has_aux=True
-        )(fake_b_primal)
+        if not use_pool:
+            # ---- 2+3. ONE D(fake) forward serving both losses -----------
+            # (module docstring, "single-forward structure"); sequential
+            # fake→real forwards preserve the reference's u/v threading
+            # order when spectral norm is on. (A batched fake‖real single
+            # forward was tried and measured SLOWER on v5e: the doubled
+            # batch worsened the big D convs' backward tiling by ~6
+            # ms/step at bs=128.)
+            loss_d, grads_d, pred_fake, pred_real, spectral2, pull = (
+                single_forward_d_losses(
+                    d_fwd, state.spectral_d, state.params_d,
+                    _concat_pair(real_a, fake_b_primal), real_pair,
+                    L.gan_mode,
+                )
+            )
+
+            (loss_g, g_parts), (ct_fake_direct, ct_pred) = jax.value_and_grad(
+                g_losses, argnums=(0, 1), has_aux=True
+            )(fake_b_primal, pred_fake)
+            # params cotangent dead (reference zero_grad) → DCE
+            grad_fake = ct_fake_direct + pull(ct_pred)[..., in_c:]
+        else:
+            # Pool active: D's fake pair is the pooled history, not the live
+            # fake — the forwards genuinely differ, keep the reference's
+            # 3-forward structure (train.py:308,315,336).
+            from p2p_tpu.utils.pool import device_pool_query
+
+            pool_rng = jax.random.fold_in(
+                jax.random.key(cfg.train.seed ^ 0x705501), state.step
+            )
+            fake_pair, pool1, pool_n1 = device_pool_query(
+                state.pool, state.pool_n,
+                _concat_pair(real_a, jax.lax.stop_gradient(fake_b_primal)),
+                pool_rng,
+            )
+            fake_pair = jax.lax.stop_gradient(fake_pair)
+
+            def loss_d_fn(params_d):
+                pred_fake, s1 = d_fwd(params_d, state.spectral_d, fake_pair)
+                pred_real, s2 = d_fwd(params_d, s1["spectral"], real_pair)
+                loss = 0.5 * (
+                    gan_loss(pred_fake, False, L.gan_mode)
+                    + gan_loss(pred_real, True, L.gan_mode)
+                )
+                return loss, (s2["spectral"], pred_real)
+
+            (loss_d, (spectral1, pred_real)), grads_d = jax.value_and_grad(
+                loss_d_fn, has_aux=True
+            )(state.params_d)
+            pred_real = jax.tree_util.tree_map(
+                jax.lax.stop_gradient, pred_real
+            )
+
+            def loss_g_fn(fake_b):
+                pred_fake_g, s3 = d_fwd(
+                    jax.lax.stop_gradient(state.params_d),
+                    spectral1,
+                    _concat_pair(real_a, fake_b),
+                )
+                total, parts = g_losses(fake_b, pred_fake_g)
+                return total, (s3["spectral"], parts)
+
+            (loss_g, (spectral2, g_parts)), grad_fake = jax.value_and_grad(
+                loss_g_fn, has_aux=True
+            )(fake_b_primal)
+
         (grads_g,) = g_vjp(grad_fake)
 
         # ---- 4. apply G then D updates (reference order) ----------------

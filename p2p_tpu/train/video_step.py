@@ -32,6 +32,7 @@ from p2p_tpu.models.registry import define_D, define_G, init_variables
 from p2p_tpu.models.temporal_d import MultiscaleTemporalDiscriminator
 from p2p_tpu.ops.tv import total_variation_loss
 from p2p_tpu.train.state import make_optimizers
+from p2p_tpu.train.step import single_forward_d_losses
 
 
 class VideoTrainState(struct.PyTreeNode):
@@ -163,63 +164,34 @@ def build_video_train_step(
         fake_f, g_vjp, bs_g = jax.vjp(g_primal, state.params_g, has_aux=True)
         fake_clip = fake_f.reshape(real_b.shape)
 
-        # ---- spatial D ----------------------------------------------------
-        def loss_d_fn(params_d):
-            pred_fake, s1 = d_fwd(
-                params_d, state.spectral_d,
-                jnp.concatenate([a_f, jax.lax.stop_gradient(fake_f)], axis=-1),
-            )
-            pred_real, s2 = d_fwd(
-                params_d, s1["spectral"], jnp.concatenate([a_f, b_f], axis=-1)
-            )
-            loss = 0.5 * (
-                gan_loss(pred_fake, False, L.gan_mode)
-                + gan_loss(pred_real, True, L.gan_mode)
-            )
-            return loss, (s2["spectral"], pred_real)
+        in_c = real_a.shape[-1]
 
-        (loss_d, (spectral1, pred_real)), grads_d = jax.value_and_grad(
-            loss_d_fn, has_aux=True
-        )(state.params_d)
-        pred_real = jax.tree_util.tree_map(jax.lax.stop_gradient, pred_real)
-
-        # ---- temporal D ---------------------------------------------------
-        def loss_dt_fn(params_dt):
-            pred_fake_t, t1 = dt_fwd(
-                params_dt, state.spectral_dt,
-                _clip_pair(real_a, jax.lax.stop_gradient(fake_clip)),
+        # ---- spatial + temporal D: ONE D(fake) forward each serves the
+        # D loss (params cotangent) and the G loss (pair cotangent) — the
+        # shared single-forward structure of train/step.py. Power
+        # iteration advances 2×/step per discriminator, not 3×.
+        loss_d, grads_d, pred_fake, pred_real, spectral2, pull_d = (
+            single_forward_d_losses(
+                d_fwd, state.spectral_d, state.params_d,
+                jnp.concatenate([a_f, fake_f], axis=-1),
+                jnp.concatenate([a_f, b_f], axis=-1),
+                L.gan_mode,
             )
-            pred_real_t, t2 = dt_fwd(
-                params_dt, t1["spectral"], _clip_pair(real_a, real_b)
+        )
+        loss_dt, grads_dt, pred_fake_t, pred_real_t, spectral_t2, pull_dt = (
+            single_forward_d_losses(
+                dt_fwd, state.spectral_dt, state.params_dt,
+                _clip_pair(real_a, fake_clip),
+                _clip_pair(real_a, real_b),
+                L.gan_mode,
             )
-            loss = 0.5 * (
-                gan_loss(pred_fake_t, False, L.gan_mode)
-                + gan_loss(pred_real_t, True, L.gan_mode)
-            )
-            return loss, (t2["spectral"], pred_real_t)
-
-        (loss_dt, (spectral_t1, pred_real_t)), grads_dt = jax.value_and_grad(
-            loss_dt_fn, has_aux=True
-        )(state.params_dt)
-        pred_real_t = jax.tree_util.tree_map(
-            jax.lax.stop_gradient, pred_real_t
         )
 
-        # ---- G (differentiated wrt the fake frames; chain rule through
-        # g_vjp gives the params_g gradient) --------------------------------
-        def loss_g_fn(fake):
-            clip = fake.reshape(real_b.shape)
-            pred_fake_g, s3 = d_fwd(
-                jax.lax.stop_gradient(state.params_d), spectral1,
-                jnp.concatenate([a_f, fake], axis=-1),
-            )
-            pred_fake_t, t3 = dt_fwd(
-                jax.lax.stop_gradient(state.params_dt), spectral_t1,
-                _clip_pair(real_a, clip),
-            )
+        # ---- G losses on the primal fake + the shared D outputs -----------
+        def g_losses(fake, pred_fake_g, pred_fake_tg):
             l_gan = gan_loss(pred_fake_g, True, L.gan_mode,
                              for_discriminator=False)
-            l_gan_t = gan_loss(pred_fake_t, True, L.gan_mode,
+            l_gan_t = gan_loss(pred_fake_tg, True, L.gan_mode,
                                for_discriminator=False)
             parts = {"g_gan": l_gan, "g_gan_t": l_gan_t}
             total = l_gan + l_gan_t
@@ -227,7 +199,7 @@ def build_video_train_step(
                 l_feat = feature_matching_loss(
                     pred_fake_g, pred_real, cfg.model.n_layers_D, L.lambda_feat
                 ) + feature_matching_loss(
-                    pred_fake_t, pred_real_t, cfg.model.n_layers_D,
+                    pred_fake_tg, pred_real_t, cfg.model.n_layers_D,
                     L.lambda_feat,
                 )
                 parts["g_feat"] = l_feat
@@ -243,15 +215,23 @@ def build_video_train_step(
                 parts["g_tv"] = l_tv
                 total = total + l_tv
             if L.lambda_l1 > 0:
+                # elementwise diff in the train dtype, f32 accumulation
+                # (see train/step.py g_losses).
                 l_l1 = jnp.mean(
-                    jnp.abs(fake.astype(jnp.float32) - b_f.astype(jnp.float32))
+                    jnp.abs(fake - b_f), dtype=jnp.float32
                 ) * L.lambda_l1
                 parts["g_l1"] = l_l1
                 total = total + l_l1
-            return total, (s3["spectral"], t3["spectral"], parts)
+            return total, parts
 
-        (loss_g, (spectral2, spectral_t2, g_parts)), grad_fake = (
-            jax.value_and_grad(loss_g_fn, has_aux=True)(fake_f)
+        (loss_g, g_parts), (ct_fake, ct_pred, ct_pred_t) = jax.value_and_grad(
+            g_losses, argnums=(0, 1, 2), has_aux=True
+        )(fake_f, pred_fake, pred_fake_t)
+        # params cotangents die (reference zero_grad before the D steps)
+        grad_fake = (
+            ct_fake
+            + pull_d(ct_pred)[..., in_c:]
+            + pull_dt(ct_pred_t)[..., in_c:].reshape(fake_f.shape)
         )
         (grads_g,) = g_vjp(grad_fake)
 

@@ -303,3 +303,43 @@ def test_pallas_instance_norm_narrow_channels_wide_rows():
     got = instance_norm_fused(x, interpret=True)
     want = _xla_instance_norm(x, None, None, 1e-5)
     assert jnp.max(jnp.abs(got - want)) < 1e-4
+
+
+# ------------------------------------------------------- subpixel deconv
+def test_subpixel_deconv_matches_conv_transpose():
+    """SubpixelDeconv(k2s1 + shifted depth-to-space) is the exact same
+    operator as flax ConvTranspose(k4, s2, 'SAME') under the weight mapping
+    W'[dh, dw, (u,v)·F] = W[2dh+u, 2dw+v] (ops/conv.py docstring)."""
+    import numpy as np
+    from flax import linen as nn
+
+    from p2p_tpu.ops.conv import SubpixelDeconv
+
+    rng = np.random.default_rng(0)
+    n, h, w, cin, f = 2, 6, 5, 7, 4
+    x = jnp.asarray(rng.normal(size=(n, h, w, cin)), jnp.float32)
+
+    deconv = nn.ConvTranspose(f, kernel_size=(4, 4), strides=(2, 2),
+                              padding="SAME")
+    vd = deconv.init(jax.random.key(0), x)
+    want = deconv.apply(vd, x)
+
+    wt = np.asarray(vd["params"]["kernel"])        # (4,4,cin,f)
+    w2 = np.zeros((2, 2, 4, cin, f), np.float32)   # (dh,dw,(u,v),cin,f)
+    for dh in range(2):
+        for dw in range(2):
+            for u in range(2):
+                for v in range(2):
+                    w2[dh, dw, u * 2 + v] = wt[2 * dh + u, 2 * dw + v]
+    sub = SubpixelDeconv(f)
+    vs = sub.init(jax.random.key(0), x)
+    # params: Conv_0/kernel (2,2,cin,4f) with out channel order (u,v,f)
+    vs = {"params": {"Conv_0": {
+        "kernel": jnp.asarray(
+            np.moveaxis(w2, 2, 3).reshape(2, 2, cin, 4 * f)),
+        "bias": vs["params"]["Conv_0"]["bias"],
+    }}}
+    got = sub.apply(vs, x)
+    assert got.shape == want.shape == (n, 2 * h, 2 * w, f)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
