@@ -20,6 +20,8 @@ locally visible devices.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 from typing import Optional, Sequence
 
@@ -105,6 +107,33 @@ def video_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+_ACTIVE_MESH: contextvars.ContextVar[Optional[Mesh]] = contextvars.ContextVar(
+    "p2p_tpu_active_mesh", default=None
+)
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Optional[Mesh]):
+    """Expose ``mesh`` to layers traced within this context.
+
+    The parallel step builders (p2p_tpu.parallel.dp) enter this around the
+    step body so ops that need manual sharding regions — the Pallas
+    InstanceNorm, which GSPMD would otherwise wrap in a full all-gather of
+    the activations (custom calls have no partitioning rule) — can wrap
+    themselves in ``shard_map`` over the active mesh at trace time.
+    """
+    token = _ACTIVE_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH.reset(token)
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The mesh made visible by :func:`mesh_context`, or None."""
+    return _ACTIVE_MESH.get()
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:

@@ -60,6 +60,7 @@ class PairedImageDataset:
         image_size: int = 256,
         image_width: Optional[int] = None,
         augment: bool = False,
+        aug_seed: int = 0,
     ):
         self.a_dir = os.path.join(root, split, "a")
         self.b_dir = os.path.join(root, split, "b")
@@ -67,6 +68,13 @@ class PairedImageDataset:
         self.h = image_size
         self.w = image_width or image_size
         self.augment = augment
+        # Augmentation entropy root. Crops/flips are a pure function of
+        # (aug_seed, item index) — the trainer bumps aug_seed once per
+        # epoch, so same-seed runs see identical augmented streams
+        # (functional-RNG stance of core/rng.py) while epochs still get
+        # fresh crops. Set BEFORE building a loader: Grain pickles the
+        # dataset into its worker processes at creation time.
+        self.aug_seed = aug_seed
         self.names = sorted(f for f in os.listdir(self.a_dir) if is_image_file(f))
         if not self.names:
             raise RuntimeError(f"no images in {self.a_dir}")
@@ -84,12 +92,12 @@ class PairedImageDataset:
         if self.augment:
             # the reference's commented-out aug (dataset.py:28-46): load at
             # 286/256-scaled size, take the SAME random crop from a and b,
-            # flip both. Fresh entropy per call → new crops every epoch.
+            # flip both. Deterministic per (aug_seed, idx) — see __init__.
             lh = self.h * 286 // 256
             lw = self.w * 286 // 256
             a = load_image(os.path.join(self.a_dir, name), lh, lw)
             b = load_image(os.path.join(self.b_dir, name), lh, lw)
-            rng = np.random.default_rng()
+            rng = np.random.default_rng((0x9E3779B9, self.aug_seed, idx))
             oy = int(rng.integers(0, lh - self.h + 1))
             ox = int(rng.integers(0, lw - self.w + 1))
             a = a[oy : oy + self.h, ox : ox + self.w]

@@ -34,6 +34,7 @@ from p2p_tpu.models.resnet_gen import ResnetBlock
 from p2p_tpu.ops.conv import ConvLayer, normal_init
 from p2p_tpu.ops.norm import InstanceNorm
 from p2p_tpu.ops.quantize import quantize, quantize_ste
+from p2p_tpu.ops.activations import relu_y
 
 
 class CompressionEncoder(nn.Module):
@@ -45,11 +46,11 @@ class CompressionEncoder(nn.Module):
     @nn.compact
     def __call__(self, x):
         y = ConvLayer(self.ngf, kernel_size=7, dtype=self.dtype)(x)
-        y = nn.relu(InstanceNorm(dtype=self.dtype)(y))
+        y = relu_y(InstanceNorm(dtype=self.dtype)(y))
         for i in range(self.n_down):
             f = self.ngf * (2 ** (i + 1))
             y = ConvLayer(f, kernel_size=3, stride=2, dtype=self.dtype)(y)
-            y = nn.relu(InstanceNorm(dtype=self.dtype)(y))
+            y = relu_y(InstanceNorm(dtype=self.dtype)(y))
         return ConvLayer(self.latent_channels, kernel_size=3,
                          dtype=self.dtype)(y)
 
@@ -80,7 +81,7 @@ class CompressionDecoder(nn.Module):
                 f, kernel_size=(3, 3), strides=(2, 2), padding="SAME",
                 dtype=self.dtype, kernel_init=normal_init(),
             )(y)
-            y = nn.relu(InstanceNorm(dtype=self.dtype)(y))
+            y = relu_y(InstanceNorm(dtype=self.dtype)(y))
         return ConvLayer(3, kernel_size=7, dtype=self.dtype)(y)
 
 

@@ -25,7 +25,7 @@ from typing import Optional, Union
 import jax.numpy as jnp
 from flax import linen as nn
 
-from p2p_tpu.ops.activations import PReLU
+from p2p_tpu.ops.activations import PReLU, leaky_relu_y, relu_y, tanh_y
 from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
 from p2p_tpu.ops.norm import make_norm
 from p2p_tpu.ops.pixel_shuffle import pixel_unshuffle
@@ -45,10 +45,10 @@ class ResidualBlock(nn.Module):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
         y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(x)
         y = mk()(y)
-        y = nn.relu(y)
+        y = relu_y(y)
         y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
         y = mk()(y)
-        return nn.relu(y + x)
+        return relu_y(y + x)
 
 
 class ExpandNetwork(nn.Module):
@@ -77,10 +77,10 @@ class ExpandNetwork(nn.Module):
             # explicit name: remat wrapping must not change param paths
             y = block_cls(self.ngf * 4, norm=self.norm, dtype=self.dtype,
                           name=f"ResidualBlock_{i}")(y, train)
-        y = nn.leaky_relu(y + residual, negative_slope=0.2)
+        y = leaky_relu_y(y + residual, 0.2)
 
         y = act(mk()(UpsampleConvLayer(self.ngf * 2, kernel_size=3, upsample=2, dtype=self.dtype)(y)))
         y = act(mk()(UpsampleConvLayer(self.ngf, kernel_size=3, upsample=2, dtype=self.dtype)(y)))
         y = UpsampleConvLayer(self.out_channels, kernel_size=9, dtype=self.dtype)(y)
         y = mk()(y)
-        return jnp.tanh(y)
+        return tanh_y(y)

@@ -30,6 +30,11 @@ from p2p_tpu.models.patchgan import avg_pool_downsample
 from p2p_tpu.models.resnet_gen import ResnetBlock, ResnetGenerator
 from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
 from p2p_tpu.ops.norm import make_norm
+from p2p_tpu.ops.activations import (
+    leaky_relu_y,
+    relu_y,
+    tanh_y,
+)
 
 
 def GlobalGenerator(
@@ -77,9 +82,9 @@ class Pix2PixHDGenerator(nn.Module):
 
         # G2 front end on the full-res input, down to half res
         y = ConvLayer(ngf_local, kernel_size=7, dtype=self.dtype)(x)
-        y = nn.relu(mk()(y))
+        y = relu_y(mk()(y))
         y = ConvLayer(self.ngf, kernel_size=3, stride=2, dtype=self.dtype)(y)
-        y = nn.relu(mk()(y))
+        y = relu_y(mk()(y))
 
         # fuse + local trunk
         y = y + g1_feats
@@ -91,6 +96,6 @@ class Pix2PixHDGenerator(nn.Module):
 
         y = UpsampleConvLayer(ngf_local, kernel_size=3, upsample=2,
                               dtype=self.dtype)(y)
-        y = nn.relu(mk()(y))
+        y = relu_y(mk()(y))
         y = ConvLayer(self.out_channels, kernel_size=7, dtype=self.dtype)(y)
-        return jnp.tanh(y)
+        return tanh_y(y)

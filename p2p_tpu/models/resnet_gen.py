@@ -21,6 +21,11 @@ from flax import linen as nn
 
 from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
 from p2p_tpu.ops.norm import make_norm
+from p2p_tpu.ops.activations import (
+    leaky_relu_y,
+    relu_y,
+    tanh_y,
+)
 
 
 class ResnetBlock(nn.Module):
@@ -35,7 +40,7 @@ class ResnetBlock(nn.Module):
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
         y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(x)
-        y = nn.relu(mk()(y))
+        y = relu_y(mk()(y))
         y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
         y = mk()(y)
         return x + y
@@ -62,11 +67,11 @@ class ResnetGenerator(nn.Module):
         cap = self.max_features or (1 << 30)
 
         y = ConvLayer(self.ngf, kernel_size=7, dtype=self.dtype)(x)
-        y = nn.relu(mk()(y))
+        y = relu_y(mk()(y))
         for i in range(self.n_downsampling):
             f = min(self.ngf * (2 ** (i + 1)), cap)
             y = ConvLayer(f, kernel_size=3, stride=2, dtype=self.dtype)(y)
-            y = nn.relu(mk()(y))
+            y = relu_y(mk()(y))
 
         block_cls = remat_wrap(ResnetBlock, self.remat)
         f_trunk = min(self.ngf * (2 ** self.n_downsampling), cap)
@@ -81,8 +86,8 @@ class ResnetGenerator(nn.Module):
             f = min(self.ngf * (2 ** i), cap)
             y = UpsampleConvLayer(f, kernel_size=3, upsample=2,
                                   dtype=self.dtype)(y)
-            y = nn.relu(mk()(y))
+            y = relu_y(mk()(y))
         if self.return_features:
             return y
         y = ConvLayer(self.out_channels, kernel_size=7, dtype=self.dtype)(y)
-        return jnp.tanh(y)
+        return tanh_y(y)

@@ -30,6 +30,11 @@ from flax import linen as nn
 from p2p_tpu.models.patchgan import avg_pool_downsample
 from p2p_tpu.ops.conv import normal_init, save_conv_out
 from p2p_tpu.ops.spectral_norm import _l2norm, spectral_normalize
+from p2p_tpu.ops.activations import (
+    leaky_relu_y,
+    relu_y,
+    tanh_y,
+)
 
 
 def avg_pool_spatial_3d(x: jax.Array) -> jax.Array:
@@ -128,16 +133,16 @@ class TemporalDiscriminator(nn.Module):
         feats = []
         nf = self.ndf
         y = _Conv3D(nf, dtype=self.dtype)(x)
-        y = nn.leaky_relu(y, negative_slope=0.2)
+        y = leaky_relu_y(y, 0.2)
         feats.append(y)
         for _ in range(1, self.n_layers):
             nf = min(nf * 2, 512)
             y = inner(y, nf, 2)
-            y = nn.leaky_relu(y, negative_slope=0.2)
+            y = leaky_relu_y(y, 0.2)
             feats.append(y)
         nf = min(nf * 2, 512)
         y = inner(y, nf, 1)
-        y = nn.leaky_relu(y, negative_slope=0.2)
+        y = leaky_relu_y(y, 0.2)
         feats.append(y)
         y = _Conv3D(1, stride_hw=1, dtype=self.dtype)(y)
         feats.append(y)

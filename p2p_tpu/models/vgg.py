@@ -28,6 +28,7 @@ import numpy as np
 from flax import linen as nn
 
 from p2p_tpu.ops.conv import save_conv_out
+from p2p_tpu.ops.activations import relu_y
 
 # (name, out_channels); 'M' = maxpool. Standard VGG19 trunk through conv5_1.
 _CFG = [
@@ -65,7 +66,7 @@ class VGG19Features(nn.Module):
             y = save_conv_out(nn.Conv(
                 ch, kernel_size=(3, 3), padding=1, dtype=self.dtype, name=name
             )(y))
-            y = nn.relu(y)
+            y = relu_y(y)
             if name in _TAPS:
                 outs.append(y)
         return outs

@@ -11,6 +11,11 @@ spatial extents stream through VMEM:
    with μ, σ², γ, β broadcast from (1,1,1,C) tiles.
 
 The tiny μ/σ² computation between passes is plain jnp and fuses away.
+
+One implementation serves both the single-device and the spatially-sharded
+case: with ``axis_name`` set (call inside a shard_map whose x spec shards H
+over that axis) the (N,1,1,C) stat tiles are psum'd across the axis between
+the passes — the activations never cross devices.
 """
 
 from __future__ import annotations
@@ -65,19 +70,15 @@ def _norm_kernel(x_ref, mean_ref, rstd_ref, scale_ref, bias_ref, y_ref):
     y_ref[...] = y.astype(y_ref.dtype)
 
 
-def _fwd_impl(x, scale, bias, eps: float, interpret: bool):
-    """Runs the two Pallas passes; returns (y, mean, rstd) with mean/rstd
-    shaped (N,1,1,C) fp32."""
+def _stats_local(x, interpret):
+    """Pass 1 on the (possibly local-shard) array: per-(n,c) Σx, Σx²."""
     n, h, w, c = x.shape
     hb = _pick_h_block(h, w, c)
-    nh = h // hb
-
     x_spec = pl.BlockSpec((1, hb, w, c), lambda i, j: (i, j, 0, 0))
     cvec_spec = pl.BlockSpec((1, 1, 1, c), lambda i, j: (i, 0, 0, 0))
-
-    s1, s2 = pl.pallas_call(
+    return pl.pallas_call(
         _stats_kernel,
-        grid=(n, nh),
+        grid=(n, h // hb),
         in_specs=[x_spec],
         out_specs=[cvec_spec, cvec_spec],
         out_shape=[
@@ -87,46 +88,67 @@ def _fwd_impl(x, scale, bias, eps: float, interpret: bool):
         interpret=interpret,
     )(x)
 
-    count = float(h * w)
-    mean = s1 / count
-    var = jnp.maximum(s2 / count - mean * mean, 0.0)
-    rstd = jax.lax.rsqrt(var + eps)
 
+def _norm_local(x, mean, rstd, scale, bias, interpret):
+    """Pass 2: y = (x − μ)·rstd·γ + β on the (possibly local-shard) array."""
+    n, h, w, c = x.shape
+    hb = _pick_h_block(h, w, c)
+    x_spec = pl.BlockSpec((1, hb, w, c), lambda i, j: (i, j, 0, 0))
+    cvec_spec = pl.BlockSpec((1, 1, 1, c), lambda i, j: (i, 0, 0, 0))
+    bcast_spec = pl.BlockSpec((1, 1, 1, c), lambda i, j: (0, 0, 0, 0))
     if scale is None:
         scale_t = jnp.ones((1, 1, 1, c), jnp.float32)
         bias_t = jnp.zeros((1, 1, 1, c), jnp.float32)
     else:
         scale_t = scale.reshape(1, 1, 1, c).astype(jnp.float32)
         bias_t = bias.reshape(1, 1, 1, c).astype(jnp.float32)
-
-    bcast_spec = pl.BlockSpec((1, 1, 1, c), lambda i, j: (0, 0, 0, 0))
-    y = pl.pallas_call(
+    return pl.pallas_call(
         _norm_kernel,
-        grid=(n, nh),
+        grid=(n, h // hb),
         in_specs=[x_spec, cvec_spec, cvec_spec, bcast_spec, bcast_spec],
         out_specs=x_spec,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         interpret=interpret,
     )(x, mean, rstd, scale_t, bias_t)
-    return y, mean, rstd
+
+
+def _fwd_impl(x, scale, bias, eps: float, interpret: bool, axis_name=None):
+    """Runs the two Pallas passes; returns (y, mean, rstd, count) with
+    mean/rstd shaped (N,1,1,C) fp32. ``axis_name`` = spatial-sharded mode
+    (see module docstring)."""
+    n, h, w, c = x.shape
+    s1, s2 = _stats_local(x, interpret)
+    if axis_name is None:
+        count = jnp.float32(h * w)
+    else:
+        s1 = jax.lax.psum(s1, axis_name)
+        s2 = jax.lax.psum(s2, axis_name)
+        count = float(h * w) * jax.lax.psum(
+            jnp.ones((), jnp.float32), axis_name)
+    mean = s1 / count
+    var = jnp.maximum(s2 / count - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = _norm_local(x, mean, rstd, scale, bias, interpret)
+    return y, mean, rstd, count
 
 
 # pallas_call has no reverse-mode rule, so the fused forward carries an
 # explicit instance-norm VJP (standard normalization backward; the two
-# backward reductions are small and XLA-fused).
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _in_fused(x, scale, bias, eps, interpret):
-    y, _, _ = _fwd_impl(x, scale, bias, eps, interpret)
+# backward reductions are small and XLA-fused — psum'd across the spatial
+# axis in sharded mode).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _in_fused(x, scale, bias, eps, interpret, axis_name):
+    y, _, _, _ = _fwd_impl(x, scale, bias, eps, interpret, axis_name)
     return y
 
 
-def _in_fused_fwd(x, scale, bias, eps, interpret):
-    y, mean, rstd = _fwd_impl(x, scale, bias, eps, interpret)
-    return y, (x, scale, bias, mean, rstd)
+def _in_fused_fwd(x, scale, bias, eps, interpret, axis_name):
+    y, mean, rstd, count = _fwd_impl(x, scale, bias, eps, interpret, axis_name)
+    return y, (x, scale, bias, mean, rstd, count)
 
 
-def _in_fused_bwd(eps, interpret, res, g):
-    x, scale, bias, mean, rstd = res
+def _in_fused_bwd(eps, interpret, axis_name, res, g):
+    x, scale, bias, mean, rstd, count = res
     x32 = x.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
     xhat = (x32 - mean) * rstd
@@ -135,12 +157,20 @@ def _in_fused_bwd(eps, interpret, res, g):
         else scale.reshape(1, 1, 1, -1).astype(jnp.float32)
     )
     dxhat = g32 * gamma
-    m1 = jnp.mean(dxhat, axis=(1, 2), keepdims=True)
-    m2 = jnp.mean(dxhat * xhat, axis=(1, 2), keepdims=True)
+    # means over the (possibly sharded) global (H, W) extent
+    m1 = jnp.sum(dxhat, axis=(1, 2), keepdims=True)
+    m2 = jnp.sum(dxhat * xhat, axis=(1, 2), keepdims=True)
+    if axis_name is not None:
+        m1 = jax.lax.psum(m1, axis_name)
+        m2 = jax.lax.psum(m2, axis_name)
+    m1 = m1 / count
+    m2 = m2 / count
     dx = (rstd * (dxhat - m1 - xhat * m2)).astype(x.dtype)
     if scale is None:
         dscale = dbias = None
     else:
+        # local contributions in sharded mode; shard_map's transpose of
+        # the replicated scale/bias in_specs psums these across devices
         dscale = jnp.sum(g32 * xhat, axis=(0, 1, 2)).astype(scale.dtype)
         dbias = jnp.sum(g32, axis=(0, 1, 2)).astype(bias.dtype)
     return dx, dscale, dbias
@@ -152,4 +182,11 @@ _in_fused.defvjp(_in_fused_fwd, _in_fused_bwd)
 @functools.partial(jax.jit, static_argnames=("eps", "interpret"))
 def instance_norm_fused(x, scale=None, bias=None, eps: float = 1e-5,
                         interpret: bool = False):
-    return _in_fused(x, scale, bias, eps, interpret)
+    return _in_fused(x, scale, bias, eps, interpret, None)
+
+
+def instance_norm_fused_sharded(x, scale=None, bias=None, eps: float = 1e-5,
+                                axis_name: str = "spatial",
+                                interpret: bool = False):
+    """InstanceNorm over an H-sharded NHWC shard (call inside shard_map)."""
+    return _in_fused(x, scale, bias, eps, interpret, axis_name)

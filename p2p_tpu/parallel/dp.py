@@ -25,7 +25,12 @@ import jax
 from jax.sharding import Mesh
 
 from p2p_tpu.core.config import Config
-from p2p_tpu.core.mesh import batch_sharding, replicated, video_sharding
+from p2p_tpu.core.mesh import (
+    batch_sharding,
+    mesh_context,
+    replicated,
+    video_sharding,
+)
 from p2p_tpu.train.step import build_train_step
 
 
@@ -62,10 +67,17 @@ def make_parallel_train_step(
     step = build_train_step(
         cfg, vgg_params, steps_per_epoch, train_dtype, jit=False
     )
+
+    def step_in_mesh(state, batch):
+        # mesh visible at trace time: ops needing manual sharding regions
+        # (Pallas InstanceNorm) wrap themselves in shard_map over it.
+        with mesh_context(mesh):
+            return step(state, batch)
+
     rep = replicated(mesh)
     bsh = batch_sharding(mesh)
     return jax.jit(
-        step,
+        step_in_mesh,
         in_shardings=(rep, bsh),
         out_shardings=(rep, rep),
         donate_argnums=0,
@@ -76,7 +88,12 @@ def make_parallel_eval_step(cfg: Config, mesh: Mesh, train_dtype=None):
     from p2p_tpu.train.step import build_eval_step
 
     step = build_eval_step(cfg, train_dtype, jit=False)
+
+    def step_in_mesh(state, batch):
+        with mesh_context(mesh):
+            return step(state, batch)
+
     rep = replicated(mesh)
     bsh = batch_sharding(mesh)
-    return jax.jit(step, in_shardings=(rep, bsh),
+    return jax.jit(step_in_mesh, in_shardings=(rep, bsh),
                    out_shardings=(bsh, rep))

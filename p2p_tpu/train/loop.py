@@ -170,8 +170,14 @@ class Trainer:
             self.plateau.scale = float(np.asarray(self.state.lr_scale))
         return True
 
-    def train_epoch(self, seed: int = 0) -> Dict[str, float]:
+    def train_epoch(self, seed: Optional[int] = None) -> Dict[str, float]:
         cfg = self.cfg
+        # Per-epoch entropy (shuffle order + augmentation crops),
+        # reproducible across same-seed runs. Defaults to the current
+        # epoch so bare train_epoch() loops still see fresh crops each
+        # epoch rather than a frozen augmented stream.
+        seed = self.epoch if seed is None else seed
+        self.train_ds.aug_seed = cfg.train.seed + seed
         loader = make_loader(
             self.train_ds, cfg.data.batch_size, shuffle=True,
             seed=cfg.train.seed + seed, num_workers=cfg.data.threads

@@ -72,11 +72,14 @@ def device_pool_query(pool, pool_n, pairs, rng):
     k_idx, k_swap = jax.random.split(rng)
     offs = pool_n + jnp.arange(n, dtype=jnp.int32)
     not_full = offs < p_size
-    # Swap targets draw only from slots already filled (earlier fill-phase
-    # samples of this batch included): a batch crossing the fill boundary
-    # must never hand D an uninitialized all-zeros pair. Modulo draw — the
-    # tiny non-uniformity is irrelevant for the pool's purpose.
-    filled = jnp.minimum(offs, p_size)
+    # Swap targets draw only from slots filled in the OLD pool (pool_n):
+    # ``stored`` gathers from the pre-update buffer, where slots being
+    # filled by earlier samples of THIS batch are still zeros — bounding
+    # by ``offs`` handed D uninitialized all-zeros pairs on fill-boundary
+    # batches. Modulo draw — the tiny non-uniformity is irrelevant for
+    # the pool's purpose. (Same-batch swap visibility, which the
+    # reference's host list has, is deliberately traded away here.)
+    filled = jnp.broadcast_to(jnp.minimum(pool_n, p_size), (n,))
     rand_idx = (
         jax.random.randint(k_idx, (n,), 0, p_size, jnp.int32)
         % jnp.maximum(filled, 1)
@@ -84,8 +87,10 @@ def device_pool_query(pool, pool_n, pairs, rng):
     swap = jax.random.uniform(k_swap, (n,)) > 0.5
 
     write_idx = jnp.where(not_full, jnp.minimum(offs, p_size - 1), rand_idx)
+    # filled == 0 (first batch larger than the whole pool): nothing valid
+    # to swap against — pass through (but still store the new pair).
+    use_stored = (~not_full) & swap & (filled > 0)
     do_write = not_full | swap
-    use_stored = (~not_full) & swap
 
     stored = pool[write_idx].astype(pairs.dtype)
     out = jnp.where(use_stored[:, None, None, None], stored, pairs)

@@ -1,0 +1,104 @@
+"""Build a REAL-photograph paired dataset from images bundled in this
+environment (no egress available) through the standard datagen CLI.
+
+Sources (all real photographs shipped inside installed wheels):
+- sklearn.datasets sample images: china.jpg, flower.jpg (427x640 photos)
+- matplotlib sample_data: grace_hopper.jpg (600x512 portrait)
+- labmaze assets: 89 photographic wall/floor/sky textures at 1024x1024
+
+The reference's own workflow is exactly this shape — tile a folder of
+source photographs into crop_size patches and write (original -> a/,
+3-bit-quantized -> b/) pairs (/root/reference/generate_dataset.py:108-165).
+Split is BY SOURCE IMAGE (no tile-level leakage between train and test).
+
+Usage:
+    python scripts/build_real_dataset.py --out dataset --name real256 \
+        --crop 256 [--test_frac 0.15] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SKLEARN_IMAGES = "sklearn/datasets/images"
+MPL_SAMPLE = "matplotlib/mpl-data/sample_data/grace_hopper.jpg"
+LABMAZE_GLOB = "labmaze/assets/**/*.png"
+
+
+def collect_sources():
+    import matplotlib
+    import sklearn
+
+    site = os.path.dirname(os.path.dirname(sklearn.__file__))
+    srcs = sorted(glob.glob(os.path.join(site, SKLEARN_IMAGES, "*.jpg")))
+    gh = os.path.join(os.path.dirname(matplotlib.__file__),
+                      "mpl-data", "sample_data", "grace_hopper.jpg")
+    if os.path.exists(gh):
+        srcs.append(gh)
+    srcs += sorted(glob.glob(os.path.join(site, LABMAZE_GLOB),
+                             recursive=True))
+    return srcs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="dataset")
+    ap.add_argument("--name", default="real256")
+    ap.add_argument("--crop", type=int, default=256)
+    ap.add_argument("--bit_size", type=int, default=3)
+    ap.add_argument("--test_frac", type=float, default=0.15)
+    ap.add_argument("--max_patches", type=int, default=24)
+    ap.add_argument("--upsampling", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from p2p_tpu.cli.generate_dataset import main as datagen_main
+
+    srcs = collect_sources()
+    if not srcs:
+        raise RuntimeError("no bundled source photographs found")
+    rng = np.random.default_rng(args.seed)
+    order = rng.permutation(len(srcs))
+    n_test = max(1, int(len(srcs) * args.test_frac))
+    splits = {
+        "test": [srcs[i] for i in order[:n_test]],
+        "train": [srcs[i] for i in order[n_test:]],
+    }
+    print(f"{len(srcs)} source photographs -> "
+          f"{len(splits['train'])} train / {len(splits['test'])} test")
+
+    stage_root = os.path.join(args.out, f"{args.name}_src")
+    for split, files in splits.items():
+        stage = os.path.join(stage_root, split)
+        os.makedirs(stage, exist_ok=True)
+        for f in files:
+            # unique flat name: parent-dir prefix avoids collisions
+            # (labmaze repeats basenames across styles)
+            tag = os.path.basename(os.path.dirname(f))
+            shutil.copy(f, os.path.join(stage, f"{tag}_{os.path.basename(f)}"))
+        rc = datagen_main([
+            "--target_dataset_folder", os.path.join(args.out, args.name),
+            "--dataset_path", stage,
+            "--split", split,
+            "--bit_size", str(args.bit_size),
+            "--crop_size", str(args.crop),
+            "--max_patches", str(args.max_patches),
+            "--upsampling", str(args.upsampling),
+        ])
+        if rc:
+            return rc
+        a_dir = os.path.join(args.out, args.name, split, "a")
+        print(f"{split}: {len(os.listdir(a_dir))} patch pairs")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

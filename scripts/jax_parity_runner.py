@@ -1,0 +1,163 @@
+"""JAX/TPU side of the FID-parity controlled comparison.
+
+Mirrors scripts/torch_reference_runner.py exactly: same model family
+(--preset reference: ExpandNetwork ngf=32 n_blocks=9 + 3-scale SN PatchGAN,
+LSGAN + 10·featmatch + 10·VGG + 1·TV; --preset facades: pix2pix U-Net +
+70×70 PatchGAN, LSGAN + 100·L1), same optimizer (Adam 2e-4,
+β=(0.5,0.999)), same SHARED fixed-seed VGG19 extractor, same data subset
+(sorted()[:subset] of dataset/<name>/train), bs=1, no compression net
+(see the torch runner's docstring for why C is omitted on both sides), and
+the same prediction-dump format for scripts/eval_fid_parity.py.
+
+Differences that remain (documented): bf16 mixed precision (this
+framework's standard mode) vs torch f32; per-epoch shuffle order; G/D
+init draws. These are run-to-run-variance-class differences.
+
+Usage:
+    python scripts/jax_parity_runner.py --data dataset/real256 \
+        --name jax_ref --epochs 2 --subset 192
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data", default="dataset/real256")
+    ap.add_argument("--preset", default="reference",
+                    choices=["reference", "facades"])
+    ap.add_argument("--name", default="jax_ref")
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--subset", type=int, default=192)
+    ap.add_argument("--test_subset", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=123)
+    ap.add_argument("--out_dir", default="result")
+    ap.add_argument("--scan_steps", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from PIL import Image
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.pipeline import PairedImageDataset
+    from p2p_tpu.models.vgg import load_vgg19_params, vgg19_params_source
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import (
+        build_eval_step,
+        build_multi_train_step,
+        build_train_step,
+    )
+    from p2p_tpu.utils.images import save_img
+
+    cfg = get_preset(args.preset)
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, use_compression_net=False),
+        data=dataclasses.replace(
+            cfg.data, root=os.path.dirname(args.data),
+            dataset=os.path.basename(args.data), batch_size=1,
+            image_size=args.size,
+        ),
+        train=dataclasses.replace(cfg.train, seed=args.seed),
+    )
+    dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
+
+    train_ds = PairedImageDataset(args.data, "train", cfg.data.direction,
+                                  args.size)
+    test_ds = PairedImageDataset(args.data, "test", cfg.data.direction,
+                                 args.size)
+    train_idx = list(range(min(args.subset, len(train_ds))))
+    test_idx = list(range(min(args.test_subset, len(test_ds))))
+    print(f"{len(train_idx)} train / {len(test_idx)} test pairs "
+          f"@ {args.size}px (sorted-prefix subsets, matching torch runner)")
+
+    vgg_params = load_vgg19_params(jnp.float32)
+    vgg_source = vgg19_params_source()
+
+    sample = {k: jnp.asarray(v)[None] for k, v in train_ds[0].items()}
+    state = create_train_state(cfg, jax.random.key(cfg.train.seed), sample,
+                               train_dtype=dtype)
+    K = args.scan_steps
+    multi_step = build_multi_train_step(cfg, vgg_params, len(train_idx),
+                                        train_dtype=dtype)
+    step1 = build_train_step(cfg, vgg_params, len(train_idx),
+                             train_dtype=dtype)
+    eval_step = build_eval_step(cfg, train_dtype=dtype)
+
+    out_root = os.path.join(args.out_dir, args.name)
+    os.makedirs(out_root, exist_ok=True)
+    log = open(f"metrics_{args.name}.jsonl", "a")
+    rng = np.random.default_rng(args.seed)
+
+    def host_batch(idxs):
+        items = [train_ds[i] for i in idxs]
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    step_count = 0
+    for epoch in range(1, args.epochs + 1):
+        order = rng.permutation(train_idx)
+        sums = {"loss_g": 0.0, "loss_d": 0.0}
+        t0 = time.time()
+        i = 0
+        n_done = 0
+        while i + K <= len(order):
+            batches = {
+                k: jnp.asarray(v[:, None]) for k, v in
+                host_batch(order[i:i + K]).items()
+            }  # (K, 1, H, W, C): scan axis over bs=1 steps
+            state, m = multi_step(state, batches)
+            sums["loss_g"] += float(jnp.sum(m["loss_g"]))
+            sums["loss_d"] += float(jnp.sum(m["loss_d"]))
+            i += K
+            n_done += K
+        while i < len(order):
+            b = {k: jnp.asarray(v) for k, v in host_batch([order[i]]).items()}
+            state, m = step1(state, b)
+            sums["loss_g"] += float(m["loss_g"])
+            sums["loss_d"] += float(m["loss_d"])
+            i += 1
+            n_done += 1
+        step_count += n_done
+        rec = {"kind": "train", "framework": "jax-tpu", "epoch": epoch,
+               "steps": step_count, "loss_g": sums["loss_g"] / n_done,
+               "loss_d": sums["loss_d"] / n_done,
+               "sec_per_step": (time.time() - t0) / n_done,
+               "vgg_feature_source": vgg_source}
+        print(json.dumps(rec)); log.write(json.dumps(rec) + "\n"); log.flush()
+
+        # eval + prediction dump (same filenames as the torch runner)
+        pred_dir = os.path.join(out_root, f"preds_e{epoch}")
+        os.makedirs(pred_dir, exist_ok=True)
+        psnrs, ssims = [], []
+        for ti in test_idx:
+            item = test_ds[ti]
+            batch = {k: jnp.asarray(v)[None] for k, v in item.items()}
+            pred, met = eval_step(state, batch)
+            save_img(np.asarray(pred[0], np.float32),
+                     os.path.join(pred_dir, test_ds.names[ti]))
+            psnrs.append(float(met["psnr"][0]))
+            ssims.append(float(met["ssim"][0]))
+        rec = {"kind": "eval", "framework": "jax-tpu", "epoch": epoch,
+               "psnr_mean": float(np.mean(psnrs)),
+               "psnr_max": float(np.max(psnrs)),
+               "ssim_mean": float(np.mean(ssims)),
+               "pred_dir": pred_dir}
+        print(json.dumps(rec)); log.write(json.dumps(rec) + "\n"); log.flush()
+    log.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,3 +25,10 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 fake CPU devices, got {len(devs)}"
     return devs[:8]
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy test (>~10 s on CPU); quick gate: -m 'not slow'",
+    )

@@ -55,6 +55,7 @@ def test_gram_matrix_properties():
     np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_style_loss_zero_for_identical_positive_otherwise():
     params = load_vgg19_params()
     x = jnp.asarray(

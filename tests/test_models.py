@@ -33,6 +33,7 @@ def test_compression_network_shape_and_residual():
     np.testing.assert_allclose(r, np.ones_like(r), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_expand_network_shape_and_range():
     x = jnp.asarray(np.random.default_rng(0).uniform(0, 1, (1, 64, 64, 3)), jnp.float32)
     net = ExpandNetwork()
@@ -88,6 +89,7 @@ def test_vgg19_taps():
     assert [o.shape[1] for o in outs] == [64, 32, 16, 8, 4]
 
 
+@pytest.mark.slow
 def test_registry_factories_and_init_types():
     cfg = ModelConfig()
     x = jnp.zeros((1, 32, 32, 3))
@@ -121,6 +123,7 @@ def test_vgg_fallback_is_deterministic():
 
 # ---------------------------------------------------------------- new G families
 
+@pytest.mark.slow
 def test_unet_generator_shapes_skips_and_grads():
     x = jnp.asarray(
         np.random.default_rng(3).uniform(-1, 1, (2, 64, 64, 3)), jnp.float32
@@ -146,6 +149,7 @@ def test_unet_generator_shapes_skips_and_grads():
         assert np.abs(g).sum() > 0, f"no grad into {name}"
 
 
+@pytest.mark.slow
 def test_unet_inference_mode_no_mutation():
     x = jnp.asarray(
         np.random.default_rng(4).uniform(-1, 1, (1, 32, 32, 3)), jnp.float32
@@ -156,6 +160,7 @@ def test_unet_inference_mode_no_mutation():
     assert y.shape == x.shape
 
 
+@pytest.mark.slow
 def test_resnet_generator_shape_block_identity_at_init():
     x = jnp.asarray(
         np.random.default_rng(5).uniform(-1, 1, (1, 32, 48, 3)), jnp.float32
@@ -181,6 +186,7 @@ def test_resnet_block_no_post_add_activation():
     assert float(jnp.min(y)) < 0
 
 
+@pytest.mark.slow
 def test_pix2pixhd_generator_shapes_and_param_split():
     x = jnp.asarray(
         np.random.default_rng(7).uniform(-1, 1, (1, 64, 64, 3)), jnp.float32
@@ -198,6 +204,7 @@ def test_pix2pixhd_generator_shapes_and_param_split():
     assert y1.shape == x.shape
 
 
+@pytest.mark.slow
 def test_registry_builds_all_generator_families():
     x = jnp.zeros((1, 32, 32, 3))
     for gen, norm in [("expand", "batch"), ("unet", "batch"),
@@ -211,6 +218,7 @@ def test_registry_builds_all_generator_families():
         assert y.shape == x.shape, gen
 
 
+@pytest.mark.slow
 def test_unet_non_power_of_two_sizes():
     # 96 = 2^5*3, 48 = 2^4*3 → depth clamps to 4, odd bottleneck survives
     x = jnp.asarray(
@@ -224,6 +232,7 @@ def test_unet_non_power_of_two_sizes():
     assert len(downs) == 4
 
 
+@pytest.mark.slow
 def test_unet_dropout_needs_rng_and_perturbs_output():
     x = jnp.asarray(
         np.random.default_rng(9).uniform(-1, 1, (1, 32, 32, 3)), jnp.float32
@@ -256,6 +265,7 @@ def test_compression_autoencoder_roundtrip_shapes():
     assert y.shape == x.shape
 
 
+@pytest.mark.slow
 def test_compression_autoencoder_quantized_latent_trains():
     from p2p_tpu.models import CompressionAutoencoder
 
@@ -279,6 +289,7 @@ def test_compression_autoencoder_quantized_latent_trains():
 
 
 @pytest.mark.parametrize("mode", [True, "conv"])
+@pytest.mark.slow
 def test_resnet_generator_remat_modes_match_no_remat(mode):
     """Both remat modes (full recompute and the conv-residuals-only policy)
     must change memory behavior ONLY — forward values and gradients match

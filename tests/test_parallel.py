@@ -91,6 +91,7 @@ def _conv_oracle(x, kernel, stride=1, mode="reflect"):
 
 @pytest.mark.parametrize("k", [3, 5])
 @pytest.mark.parametrize("edge_mode", ["reflect", "zero"])
+@pytest.mark.slow
 def test_sharded_conv2d_matches_unsharded(devices8, k, edge_mode):
     mesh = _axis_mesh(devices8, 4, "spatial")
     x = jax.random.normal(jax.random.key(1), (2, 32, 16, 4))
@@ -120,6 +121,7 @@ def test_gspmd_stride2_conv_matches_unsharded(devices8):
 
 # ---------------------------------------------------------------- temporal
 
+@pytest.mark.slow
 def test_sharded_temporal_conv3d_matches_unsharded(devices8):
     mesh = _axis_mesh(devices8, 4, "time")
     x = jax.random.normal(jax.random.key(5), (2, 8, 6, 6, 3))
@@ -149,6 +151,7 @@ def _tiny_cfg(batch):
     )
 
 
+@pytest.mark.slow
 def test_dp_train_step_matches_single_device(devices8):
     from p2p_tpu.train.state import create_train_state
     from p2p_tpu.train.step import build_train_step
@@ -183,6 +186,7 @@ def test_dp_train_step_matches_single_device(devices8):
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_data_spatial_mixed_mesh_runs(devices8):
     """data=2 × spatial=2 × time=2 mesh: the full step compiles and runs
     with batch sharded over data AND H over spatial on a 3-axis mesh."""

@@ -33,6 +33,7 @@ IMAGE_PRESETS = [p for p in list_presets() if p != "vid2vid_temporal"]
 
 
 @pytest.mark.parametrize("preset", IMAGE_PRESETS)
+@pytest.mark.slow
 def test_preset_trains_two_steps(preset):
     cfg = _shrink(get_preset(preset))
     rng = np.random.default_rng(0)
@@ -52,6 +53,7 @@ def test_preset_trains_two_steps(preset):
     assert losses[-1] < losses[0] * 1.02, (preset, losses)
 
 
+@pytest.mark.slow
 def test_vid2vid_preset_trains():
     from p2p_tpu.train.video_step import (
         build_video_train_step,

@@ -79,6 +79,7 @@ def test_plateau_controller():
 
 
 # ------------------------------------------------------------ train step
+@pytest.mark.slow
 def test_train_step_runs_and_updates_everything(batch):
     cfg = tiny_config()
     state = create_train_state(cfg, jax.random.key(0), batch, 1)
@@ -106,6 +107,7 @@ def test_train_step_runs_and_updates_everything(batch):
     assert moved(state0.spectral_d, state1.spectral_d)
 
 
+@pytest.mark.slow
 def test_train_step_no_compression_pix2pix(batch):
     cfg = tiny_config(use_compression_net=False, use_spectral_norm=False)
     cfg = Config(
@@ -122,6 +124,7 @@ def test_train_step_no_compression_pix2pix(batch):
     assert state1.params_c is None
 
 
+@pytest.mark.slow
 def test_loss_decreases_over_steps(batch):
     cfg = tiny_config()
     state = create_train_state(cfg, jax.random.key(0), batch, 1)
@@ -134,6 +137,7 @@ def test_loss_decreases_over_steps(batch):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_lr_scale_gates_updates(batch):
     """lr_scale=0 (plateau floor) must freeze all params; the schedules'
     PlateauController drives this field host-side."""
@@ -148,6 +152,7 @@ def test_lr_scale_gates_updates(batch):
         np.testing.assert_allclose(a, b, atol=0)
 
 
+@pytest.mark.slow
 def test_bug_compatible_quantizer_freezes_c(batch):
     cfg = tiny_config(quant_ste=False)
     state0 = create_train_state(cfg, jax.random.key(0), batch, 1)
@@ -176,6 +181,7 @@ def test_eval_step(batch):
 
 
 # ------------------------------------------------------------ checkpoint
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path, batch):
     from p2p_tpu.train.checkpoint import CheckpointManager
 
@@ -201,6 +207,7 @@ def test_checkpoint_roundtrip(tmp_path, batch):
     mgr.close()
 
 
+@pytest.mark.slow
 def test_multi_step_scan_matches_sequential():
     """build_multi_train_step(K) == K sequential build_train_step calls."""
     import dataclasses
@@ -280,6 +287,7 @@ def test_device_pool_semantics():
     assert 0.25 < swaps / (n_steps - P) < 0.75  # p≈0.5 swap rate
 
 
+@pytest.mark.slow
 def test_train_step_with_pool_enabled(tmp_path):
     """pool_size > 0 threads the ring buffer through the jitted step, the
     Orbax checkpoint round-trip, and a restore into a template rebuilt the
@@ -316,3 +324,34 @@ def test_train_step_with_pool_enabled(tmp_path):
     np.testing.assert_array_equal(np.asarray(restored.pool),
                                   np.asarray(state.pool))
     assert int(restored.pool_n) == 4
+
+
+def test_device_pool_boundary_batch_never_returns_zeros():
+    """ADVICE r1: a batch crossing the fill boundary must never hand D an
+    uninitialized all-zeros pair — swap draws address only slots filled in
+    the PRE-update pool (pool_n), not slots being filled by earlier samples
+    of the same batch."""
+    from p2p_tpu.utils.pool import device_pool_query
+
+    P, bs = 4, 2
+    q = jax.jit(device_pool_query)
+    for key in range(200):
+        # pool_n=3 of 4 filled with nonzero sentinels; batch of 2 crosses
+        # the boundary (one fills slot 3, one is past the boundary).
+        pool = jnp.concatenate([
+            jnp.full((3, 2, 2, 1), 7.0), jnp.zeros((1, 2, 2, 1))])
+        pool_n = jnp.asarray(3, jnp.int32)
+        pairs = jnp.stack([jnp.full((2, 2, 1), 11.0),
+                           jnp.full((2, 2, 1), 12.0)])
+        out, new_pool, new_n = q(pool, pool_n, pairs, jax.random.key(key))
+        vals = np.asarray(out).reshape(bs, -1)[:, 0]
+        assert (vals != 0.0).all(), (key, vals)
+        assert set(np.round(vals, 3)).issubset({7.0, 11.0, 12.0})
+        assert int(new_n) == 4
+    # empty-pool edge: first batch larger than the whole pool passes through
+    pool = jnp.zeros((2, 2, 2, 1))
+    pairs = jnp.stack([jnp.full((2, 2, 1), float(v)) for v in (1, 2, 3, 4)])
+    for key in range(50):
+        out, _, _ = q(pool, jnp.asarray(0, jnp.int32), pairs,
+                      jax.random.key(key))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(pairs))

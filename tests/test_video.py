@@ -1,6 +1,7 @@
 """vid2vid path: temporal discriminator + video train step, incl. the
 sequence-parallel (time-sharded) GSPMD execution (BASELINE configs[4])."""
 
+import pytest
 import dataclasses
 
 import jax
@@ -42,6 +43,7 @@ def _batch(batch=2, frames=8, size=16, seed=0):
     }
 
 
+@pytest.mark.slow
 def test_temporal_d_stages_and_t_preserved():
     x = jnp.zeros((1, 8, 32, 32, 6))
     d = TemporalDiscriminator(ndf=8, n_layers=3)
@@ -64,6 +66,7 @@ def test_multiscale_temporal_d_finest_first():
     assert all(f.shape[1] == 4 for scale in out for f in scale)
 
 
+@pytest.mark.slow
 def test_video_train_step_losses_decrease():
     cfg = _tiny_cfg()
     batch = _batch()
@@ -79,6 +82,7 @@ def test_video_train_step_losses_decrease():
         assert np.isfinite(float(metrics[k])), k
 
 
+@pytest.mark.slow
 def test_video_step_time_sharded_matches_unsharded(devices8):
     cfg = _tiny_cfg()
     batch = _batch(seed=3)
@@ -105,6 +109,7 @@ def test_video_step_time_sharded_matches_unsharded(devices8):
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_temporal_d_spectral_norm_state_threads():
     cfg = _tiny_cfg()
     batch = _batch(seed=5)
@@ -138,6 +143,7 @@ def test_video_clip_dataset_windows(tmp_path):
     assert len(np.unique(item["input"])) < len(np.unique(item["target"]))
 
 
+@pytest.mark.slow
 def test_video_trainer_end_to_end(tmp_path):
     from p2p_tpu.data.video import make_synthetic_video_dataset
     from p2p_tpu.train.video_loop import VideoTrainer
