@@ -31,13 +31,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=str, default=None,
                    help="mesh axes 'data,spatial,time' e.g. '4,2,1' "
                         "(data may be -1 = all remaining devices)")
+    p.add_argument("--image_width", type=int, default=None,
+                   help="image width when not square (e.g. pix2pixhd "
+                        "1024x512 trains height=512 width=1024)")
     p.add_argument("--image_size", type=int, default=None,
                    help="override preset image size (height; square unless "
                         "the preset sets a width)")
     p.add_argument("--n_blocks", type=int, default=None,
                    help="override generator residual block count")
     p.add_argument("--upsample_mode", type=str, default=None,
-                   choices=["deconv", "resize"],
+                   choices=["deconv", "subpixel", "resize"],
                    help="U-Net decoder upsampling (deconv = torch-parity "
                         "ConvTranspose; resize = nearest+conv)")
     p.add_argument("--augment", action="store_true", default=None,
@@ -81,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="train steps fused into one lax.scan dispatch "
                         "(amortizes host/tunnel latency; metrics are still "
                         "logged per step)")
+    p.add_argument("--phase", choices=["global", "full"], default=None,
+                   help="pix2pixHD coarse-to-fine schedule: 'global' trains "
+                        "G1 alone at half resolution (checkpoints under "
+                        "<name>_g1); 'full' trains the enhancer-wrapped "
+                        "generator with the phase-1 G1 weights grafted in")
+    p.add_argument("--init_g1_from", type=str, default=None,
+                   help="explicit phase-1 checkpoint dir for --phase full "
+                        "(default: checkpoint/<dataset>/<name>_g1)")
     return p
 
 
@@ -101,8 +112,14 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  niter=args.niter, niter_decay=args.niter_decay)
     data = over(data, dataset=args.dataset, direction=args.direction,
                 batch_size=args.batch_size, image_size=args.image_size,
+                image_width=args.image_width,
                 test_batch_size=args.test_batch_size, threads=args.threads,
                 augment=args.augment)
+    if args.image_size is not None and args.image_width is None and \
+            data.image_width is not None:
+        # an explicit square --image_size overrides a rectangular preset
+        # wholesale (halving only one dim silently breaks aspect handling)
+        data = dataclasses.replace(data, image_width=None)
     train = over(train, nepoch=args.nepoch, epoch_count=args.epoch_count,
                  epoch_save=args.epochsave, seed=args.seed,
                  eval_fid=args.eval_fid, scan_steps=args.scan_steps,
@@ -111,23 +128,35 @@ def config_from_flags(args: argparse.Namespace) -> Config:
         from p2p_tpu.core.mesh import MeshSpec
 
         try:
-            d, s, t = (int(v) for v in args.mesh.split(","))
+            vals = [int(v) for v in args.mesh.split(",")]
+            if len(vals) == 3:
+                vals.append(1)
+            d, s, t, m = vals
         except ValueError:
             raise SystemExit(
-                f"--mesh must be three comma-separated ints "
-                f"'data,spatial,time' (got {args.mesh!r})"
+                f"--mesh must be 'data,spatial,time[,model]' comma-separated "
+                f"ints (got {args.mesh!r})"
             )
-        if s < 1 or t < 1 or (d < 1 and d != -1):
+        if s < 1 or t < 1 or m < 1 or (d < 1 and d != -1):
             raise SystemExit(
                 "--mesh axes must be >=1 (data may be -1 = all remaining "
                 f"devices); got {args.mesh!r}"
             )
-        par = dataclasses.replace(par, mesh=MeshSpec(data=d, spatial=s, time=t))
+        par = dataclasses.replace(
+            par, mesh=MeshSpec(data=d, spatial=s, time=t, model=m))
     name = args.name or cfg.name
-    return dataclasses.replace(
+    cfg = dataclasses.replace(
         cfg, name=name, model=model, loss=loss, optim=optim, data=data,
         train=train, parallel=par,
     )
+    if getattr(args, "phase", None) == "global":
+        # coarse-to-fine phase 1 — applied AFTER flag overrides so an
+        # explicit --image_size/--name is halved/suffixed consistently,
+        # and with the same helper phase 2 uses to locate the checkpoint.
+        from p2p_tpu.train.graft import g1_phase_config
+
+        cfg = g1_phase_config(cfg)
+    return cfg
 
 
 def main(argv=None) -> int:
@@ -146,6 +175,15 @@ def main(argv=None) -> int:
     resumed = trainer.maybe_resume()
     if resumed:
         print(f"resumed at epoch {trainer.epoch}")
+    elif getattr(args, "phase", None) == "full":
+        # coarse-to-fine phase 2: graft the phase-1 G1 checkpoint
+        # (<name>_g1) into the full generator before training starts.
+        from p2p_tpu.train.graft import load_and_graft_g1
+
+        trainer.state = load_and_graft_g1(
+            trainer.state, cfg, workdir=args.workdir,
+            g1_dir=args.init_g1_from, mesh=getattr(trainer, "mesh", None),
+        )
     trainer.fit()
     return 0
 

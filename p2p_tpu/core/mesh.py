@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 SPATIAL_AXIS = "spatial"
 TIME_AXIS = "time"
-ALL_AXES = (DATA_AXIS, SPATIAL_AXIS, TIME_AXIS)
+MODEL_AXIS = "model"   # tensor parallelism: conv channel dims (parallel/tp.py)
+ALL_AXES = (DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,21 +43,24 @@ class MeshSpec:
     data: int = -1
     spatial: int = 1
     time: int = 1
+    model: int = 1   # tensor-parallel axis (channel dims; parallel/tp.py)
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int]:
-        d, s, t = self.data, self.spatial, self.time
-        fixed = s * t
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        d, s, t, m = self.data, self.spatial, self.time, self.model
+        fixed = s * t * m
         if d == -1:
             if n_devices % fixed:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by spatial*time={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"spatial*time*model={fixed}"
                 )
             d = n_devices // fixed
-        if d * s * t > n_devices:
+        if d * s * t * m > n_devices:
             raise ValueError(
-                f"mesh {d}x{s}x{t} needs more than the {n_devices} devices available"
+                f"mesh {d}x{s}x{t}x{m} needs more than the {n_devices} "
+                "devices available"
             )
-        return d, s, t
+        return d, s, t, m
 
 
 def make_mesh(
@@ -71,9 +75,11 @@ def make_mesh(
     (time) live; data-parallel all-reduces tolerate the longer hops.
     """
     devices = list(devices if devices is not None else jax.devices())
-    d, s, t = spec.resolve(len(devices))
-    dev_array = np.asarray(devices[: d * s * t]).reshape(d, s, t)
-    return Mesh(dev_array, axis_names=(DATA_AXIS, SPATIAL_AXIS, TIME_AXIS))
+    d, s, t, m = spec.resolve(len(devices))
+    dev_array = np.asarray(devices[: d * s * t * m]).reshape(d, s, t, m)
+    return Mesh(
+        dev_array, axis_names=(DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS)
+    )
 
 
 def single_device_mesh() -> Mesh:

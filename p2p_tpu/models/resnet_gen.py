@@ -22,7 +22,6 @@ from flax import linen as nn
 from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
 from p2p_tpu.ops.norm import make_norm
 from p2p_tpu.ops.activations import (
-    leaky_relu_y,
     relu_y,
     tanh_y,
 )

@@ -30,11 +30,7 @@ from flax import linen as nn
 from p2p_tpu.models.patchgan import avg_pool_downsample
 from p2p_tpu.ops.conv import normal_init, save_conv_out
 from p2p_tpu.ops.spectral_norm import _l2norm, spectral_normalize
-from p2p_tpu.ops.activations import (
-    leaky_relu_y,
-    relu_y,
-    tanh_y,
-)
+from p2p_tpu.ops.activations import leaky_relu_y
 
 
 def avg_pool_spatial_3d(x: jax.Array) -> jax.Array:

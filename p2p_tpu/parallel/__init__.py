@@ -2,6 +2,8 @@
 
 - ``dp``       data parallelism (+ mixed data×spatial) via sharding
                annotations on the jitted train step; GSPMD collectives.
+- ``tp``       tensor parallelism: Megatron-style channel shards on the
+               ResNet trunk's conv pairs over the ``model`` mesh axis.
 - ``spatial``  GSPMD spatial sharding of H with explicit shard_map halo
                exchange for the stride-1 conv trunk.
 - ``temporal`` sequence parallelism over video frames for the vid2vid
@@ -22,6 +24,7 @@ from p2p_tpu.parallel.dp import (
     shard_batch,
 )
 from p2p_tpu.parallel.halo import halo_exchange, ring_shift
+from p2p_tpu.parallel.tp import place_state_tp, tp_sharding_tree
 from p2p_tpu.parallel.spatial import (
     check_spatial_divisible,
     conv2d_local,
@@ -42,6 +45,8 @@ __all__ = [
     "replicate_state",
     "shard_batch",
     "halo_exchange",
+    "place_state_tp",
+    "tp_sharding_tree",
     "ring_shift",
     "check_spatial_divisible",
     "conv2d_local",

@@ -41,13 +41,15 @@ def replicate_state(state: Any, mesh: Mesh) -> Any:
 
 def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]:
     """Place a host batch with N over data (and H over spatial, T over time
-    for 5-D video tensors)."""
+    for 5-D video tensors); multi-process assembly handled by
+    :func:`p2p_tpu.data.pipeline.place_global`."""
+    from p2p_tpu.data.pipeline import place_global
+
     img = batch_sharding(mesh)
     vid = video_sharding(mesh)
-    return {
-        k: jax.device_put(v, vid if getattr(v, "ndim", 4) == 5 else img)
-        for k, v in batch.items()
-    }
+    return place_global(
+        batch, lambda v: vid if getattr(v, "ndim", 4) == 5 else img
+    )
 
 
 def make_parallel_train_step(
@@ -56,6 +58,7 @@ def make_parallel_train_step(
     vgg_params: Optional[Any] = None,
     steps_per_epoch: int = 1,
     train_dtype=None,
+    state_sharding: Optional[Any] = None,
 ):
     """The single-device train step, jitted over ``mesh``.
 
@@ -63,6 +66,10 @@ def make_parallel_train_step(
     replicated and ``batch`` is sharded per :func:`shard_batch`. Gradient
     psums, BN stat reductions, and (for spatial>1) conv halo exchanges are
     all GSPMD-inserted.
+
+    ``state_sharding``: optional NamedSharding pytree for the TrainState
+    (e.g. ``parallel.tp.tp_sharding_tree`` for tensor parallelism over the
+    ``model`` axis); defaults to fully replicated.
     """
     step = build_train_step(
         cfg, vgg_params, steps_per_epoch, train_dtype, jit=False
@@ -76,10 +83,11 @@ def make_parallel_train_step(
 
     rep = replicated(mesh)
     bsh = batch_sharding(mesh)
+    ssh = rep if state_sharding is None else state_sharding
     return jax.jit(
         step_in_mesh,
-        in_shardings=(rep, bsh),
-        out_shardings=(rep, rep),
+        in_shardings=(ssh, bsh),
+        out_shardings=(ssh, rep),
         donate_argnums=0,
     )
 

@@ -1,0 +1,136 @@
+"""Coarse-to-fine parameter graft for the pix2pixHD schedule.
+
+pix2pixHD trains in two phases: the GlobalGenerator G1 alone at half
+resolution, then the full enhancer-wrapped generator at full resolution
+with G1's weights carried over (the paper's coarse-to-fine schedule;
+BASELINE configs[3]). Phase 1 here is the ``pix2pixhd_global`` family
+(models/registry.py:66); this module moves its trained parameters into the
+``global`` submodule of the full :class:`Pix2PixHDGenerator` tree.
+
+The one structural difference: standalone G1 carries the c7s1-out image
+head (its last ConvLayer), which the embedded G1 lacks
+(``return_features=True`` taps the pre-output features —
+models/resnet_gen.py:90). The head is dropped on graft, exactly as the
+paper discards G1's output layer when attaching the enhancer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def graft_tree(dst: Dict[str, Any], src: Dict[str, Any],
+               path: str = "") -> Tuple[Dict[str, Any], List[str], List[str]]:
+    """Copy every leaf of ``src`` that exists (same path, same shape) in
+    ``dst``. Returns (new_dst, grafted_paths, dropped_paths)."""
+    out = dict(dst)
+    grafted: List[str] = []
+    dropped: List[str] = []
+    for k, v in src.items():
+        p = f"{path}/{k}"
+        if k not in dst:
+            dropped.append(p)
+            continue
+        if isinstance(v, dict) and isinstance(dst[k], dict):
+            out[k], g, d = graft_tree(dst[k], v, p)
+            grafted += g
+            dropped += d
+        elif getattr(dst[k], "shape", None) == getattr(v, "shape", None):
+            out[k] = v
+            grafted.append(p)
+        else:
+            raise ValueError(
+                f"graft shape mismatch at {p}: "
+                f"{getattr(dst[k], 'shape', None)} vs {getattr(v, 'shape', None)}"
+            )
+    return out, grafted, dropped
+
+
+def graft_global_into_full(full_params_g: Dict[str, Any],
+                           g1_params: Dict[str, Any],
+                           verbose: bool = True) -> Dict[str, Any]:
+    """Return ``full_params_g`` with phase-1 G1 parameters grafted into its
+    ``global`` submodule. G1's image head (absent from the embedded G1) is
+    dropped; every other leaf must match by path and shape."""
+    if "global" not in full_params_g:
+        raise ValueError(
+            "full generator params carry no 'global' submodule — is the "
+            "generator family 'pix2pixhd'?"
+        )
+    new_global, grafted, dropped = graft_tree(
+        full_params_g["global"], g1_params, "global"
+    )
+    if not grafted:
+        raise ValueError("graft copied nothing — wrong phase-1 checkpoint?")
+    if verbose:
+        print(
+            f"coarse-to-fine graft: {len(grafted)} leaves into 'global', "
+            f"{len(dropped)} head leaves dropped "
+            f"({', '.join(dropped) if dropped else 'none'})"
+        )
+    out = dict(full_params_g)
+    out["global"] = new_global
+    return out
+
+
+def g1_phase_config(cfg):
+    """The phase-1 config implied by a full pix2pixHD config: G1 family,
+    half resolution, ``<name>_g1`` checkpoint namespace."""
+    name = cfg.name if cfg.name.endswith("_g1") else cfg.name + "_g1"
+    return dataclasses.replace(
+        cfg,
+        name=name,
+        model=dataclasses.replace(cfg.model, generator="pix2pixhd_global"),
+        data=dataclasses.replace(
+            cfg.data,
+            image_size=cfg.data.image_size // 2,
+            image_width=(cfg.data.image_width // 2
+                         if cfg.data.image_width else None),
+        ),
+    )
+
+
+def load_and_graft_g1(state, cfg, workdir: str = ".",
+                      g1_dir: Optional[str] = None, mesh=None):
+    """Restore the phase-1 (``pix2pixhd_global``) checkpoint and graft its
+    generator into ``state.params_g``. Returns the updated state (re-placed
+    replicated over ``mesh`` when given — restored arrays arrive committed
+    to one device, which a mesh-jitted step would refuse); raises
+    FileNotFoundError when no phase-1 checkpoint exists."""
+    import jax
+    import numpy as np
+
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.state import create_train_state
+
+    g1_cfg = g1_phase_config(cfg)
+    if g1_dir is None:
+        g1_dir = os.path.join(
+            workdir, cfg.train.checkpoint_dir, cfg.data.dataset, g1_cfg.name
+        )
+    if not os.path.isdir(g1_dir):
+        # check BEFORE constructing a CheckpointManager: it mkdir()s its
+        # directory, which would litter empty trees on typo'd paths
+        raise FileNotFoundError(
+            f"no phase-1 checkpoint directory at {g1_dir}; run "
+            "--phase global first or pass --init_g1_from"
+        )
+    h, w = g1_cfg.data.image_size, g1_cfg.data.image_width
+    sample = synthetic_batch(batch_size=1, size=h, width=w,
+                             bits=g1_cfg.model.quant_bits)
+    sample = {k: np.asarray(v) for k, v in sample.items()}
+    template = create_train_state(g1_cfg, jax.random.key(0), sample)
+    g1_state = CheckpointManager(g1_dir).restore(template)
+    print(f"phase-1 G1 restored from {g1_dir} (step "
+          f"{int(np.asarray(g1_state.step))})")
+    state = state.replace(
+        params_g=graft_global_into_full(state.params_g, g1_state.params_g)
+    )
+    if mesh is not None:
+        from p2p_tpu.core.mesh import replicated
+
+        state = jax.device_put(state, replicated(mesh))
+    return state

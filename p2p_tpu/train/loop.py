@@ -22,7 +22,7 @@ import jax
 import numpy as np
 
 from p2p_tpu.core.config import Config
-from p2p_tpu.core.mesh import batch_sharding, make_mesh
+from p2p_tpu.core.mesh import local_batch_size, batch_sharding, make_mesh
 from p2p_tpu.data.pipeline import PairedImageDataset, device_prefetch, make_loader
 from p2p_tpu.models.vgg import load_vgg19_params
 from p2p_tpu.train.checkpoint import CheckpointManager
@@ -87,6 +87,13 @@ class Trainer:
             make_mesh(cfg.parallel.mesh) if use_mesh else None
         )
         self.batch_sharding = batch_sharding(self.mesh) if self.mesh else None
+        # Multi-host input: each process loads 1/process_count of the
+        # GLOBAL batch (Grain shards records per process; device_prefetch
+        # assembles the global array). cfg.data.batch_size is always the
+        # global batch.
+        self.local_bs = local_batch_size(cfg.data.batch_size, self.mesh)
+        self.local_test_bs = local_batch_size(
+            cfg.data.test_batch_size, self.mesh)
 
         dtype = None
         if cfg.train.mixed_precision:
@@ -127,17 +134,35 @@ class Trainer:
             cfg, jax.random.key(cfg.train.seed), sample,
             self.steps_per_epoch, dtype,
         )
-        self.train_step = build_train_step(
+
+        def with_mesh(fn):
+            # Tracing happens inside the first CALL of a jitted fn, so
+            # wrapping the call in mesh_context makes the mesh visible to
+            # trace-time dispatch — the sharded Pallas InstanceNorm reads
+            # it to wrap itself in shard_map; without this the spatial>1
+            # CLI path would all-gather activations around the custom call.
+            if self.mesh is None:
+                return fn
+
+            from p2p_tpu.core.mesh import mesh_context
+
+            def wrapped(*a, **kw):
+                with mesh_context(self.mesh):
+                    return fn(*a, **kw)
+
+            return wrapped
+
+        self.train_step = with_mesh(build_train_step(
             cfg, self.vgg_params, self.steps_per_epoch, dtype
-        )
+        ))
         self.multi_step = None
         if cfg.train.scan_steps > 1:
             from p2p_tpu.train.step import build_multi_train_step
 
-            self.multi_step = build_multi_train_step(
+            self.multi_step = with_mesh(build_multi_train_step(
                 cfg, self.vgg_params, self.steps_per_epoch, dtype
-            )
-        self.eval_step = build_eval_step(cfg, dtype)
+            ))
+        self.eval_step = with_mesh(build_eval_step(cfg, dtype))
         ckpt_dir = os.path.join(
             workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
         )
@@ -178,10 +203,16 @@ class Trainer:
         # epoch rather than a frozen augmented stream.
         seed = self.epoch if seed is None else seed
         self.train_ds.aug_seed = cfg.train.seed + seed
+        # Worker processes are pickled a FRESH copy of the dataset each
+        # epoch, which would empty the decode memo and re-decode every
+        # image — when the split is cached, in-process loading keeps the
+        # memo hot (decode cost is paid exactly once, epoch 1).
+        workers = 0 if self.train_ds.cache_enabled else (
+            cfg.data.threads if len(self.train_ds) > 64 else 0
+        )
         loader = make_loader(
-            self.train_ds, cfg.data.batch_size, shuffle=True,
-            seed=cfg.train.seed + seed, num_workers=cfg.data.threads
-            if len(self.train_ds) > 64 else 0,
+            self.train_ds, self.local_bs, shuffle=True,
+            seed=cfg.train.seed + seed, num_workers=workers,
         )
         # Keep a device-side running sum (no host sync mid-epoch, no buffer
         # pile-up) and transfer ONCE at epoch end, so averages cover EVERY
@@ -295,7 +326,7 @@ class Trainer:
         # the other hosts; multi-host eval keeps the even-batch guarantee.
         full_coverage = jax.process_count() == 1
         loader = make_loader(
-            self.test_ds, cfg.data.test_batch_size, shuffle=False,
+            self.test_ds, self.local_test_bs, shuffle=False,
             num_epochs=1, drop_remainder=not full_coverage,
         )
         psnrs: List[float] = []

@@ -25,9 +25,16 @@ from p2p_tpu.core.config import OptimConfig
 
 
 def lambda_rule(epoch, epoch_count: int, niter: int, niter_decay: int):
-    """The reference's linear-decay multiplier (networks.py:106-109)."""
-    return 1.0 - jnp.maximum(0.0, epoch + epoch_count - niter) / float(
-        niter_decay + 1
+    """The reference's linear-decay multiplier (networks.py:106-109),
+    clamped at 0: the reference formula goes NEGATIVE past
+    ``niter + niter_decay`` (it never trains that long; a run that does —
+    observed via a miscounted steps_per_epoch — flips to gradient ASCENT
+    and detonates the loss within tens of steps)."""
+    return jnp.maximum(
+        0.0,
+        1.0 - jnp.maximum(0.0, epoch + epoch_count - niter) / float(
+            niter_decay + 1
+        ),
     )
 
 

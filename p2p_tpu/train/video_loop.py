@@ -85,6 +85,12 @@ class VideoTrainer:
             make_mesh(cfg.parallel.mesh) if use_mesh else None
         )
         self.clip_sharding = video_sharding(self.mesh) if self.mesh else None
+        # global batch in cfg; per-process local batch for the loaders
+        # (device_prefetch assembles the global array on >1 process)
+        from p2p_tpu.core.mesh import local_batch_size
+        self.local_bs = local_batch_size(cfg.data.batch_size, self.mesh)
+        self.local_test_bs = local_batch_size(
+            cfg.data.test_batch_size, self.mesh)
 
         dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
         self.vgg_params = (
@@ -147,7 +153,7 @@ class VideoTrainer:
     def train_epoch(self, seed: int = 0) -> Dict[str, float]:
         cfg = self.cfg
         loader = make_loader(
-            self.train_ds, cfg.data.batch_size, shuffle=True,
+            self.train_ds, self.local_bs, shuffle=True,
             seed=cfg.train.seed + seed,
             num_workers=cfg.data.threads if len(self.train_ds) > 64 else 0,
         )
@@ -240,7 +246,7 @@ class VideoTrainer:
     def evaluate(self) -> Dict[str, float]:
         cfg = self.cfg
         loader = make_loader(
-            self.test_ds, cfg.data.test_batch_size, shuffle=False,
+            self.test_ds, self.local_test_bs, shuffle=False,
             num_epochs=1, drop_remainder=jax.process_count() > 1,
         )
         psnrs: List[float] = []

@@ -1,0 +1,149 @@
+"""Coarse-to-fine vs cold-start at equal wall-clock budget (VERDICT r1 #7).
+
+Staged: train G1 (pix2pixhd_global) at half resolution, graft into the full
+Pix2PixHDGenerator, continue at full resolution. Cold: train the full
+generator from scratch. The cold run gets the SAME wall-clock budget as the
+staged run's total (its step count is set from measured per-step times), and
+both are evaluated on the same held-out real-photo test images.
+
+    python scripts/coarse_to_fine_exp.py --data dataset/real256 \
+        --size 256 --g1_steps 300 --full_steps 300
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data", default="dataset/real256")
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--g1_steps", type=int, default=300)
+    ap.add_argument("--full_steps", type=int, default=300)
+    ap.add_argument("--bs", type=int, default=4)
+    ap.add_argument("--test_subset", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=123)
+    ap.add_argument("--json", default="metrics_coarse_to_fine.json")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.pipeline import PairedImageDataset
+    from p2p_tpu.train.graft import g1_phase_config, graft_global_into_full
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_eval_step, build_train_step
+
+    base = get_preset("pix2pixhd")
+    base = base.replace(
+        name="c2f",
+        data=dataclasses.replace(
+            base.data, root=os.path.dirname(args.data),
+            dataset=os.path.basename(args.data), batch_size=args.bs,
+            image_size=args.size, image_width=None,
+        ),
+        # no VGG asset in this image; an L1 anchor replaces the perceptual
+        # term's stabilizing role (symmetric across both arms)
+        loss=dataclasses.replace(base.loss, lambda_vgg=0.0, lambda_l1=10.0),
+    )
+    dtype = jnp.bfloat16
+    g1_cfg = g1_phase_config(base)
+
+    full_ds = PairedImageDataset(args.data, "train", base.data.direction,
+                                 args.size)
+    half_ds = PairedImageDataset(args.data, "train", base.data.direction,
+                                 args.size // 2)
+    test_ds = PairedImageDataset(args.data, "test", base.data.direction,
+                                 args.size)
+    rng = np.random.default_rng(args.seed)
+
+    def batches(ds, n_steps, bs):
+        order = rng.permutation(len(ds))
+        for i in range(n_steps):
+            idxs = [int(order[(i * bs + j) % len(ds)]) for j in range(bs)]
+            items = [ds[k] for k in idxs]
+            yield {k: jnp.asarray(np.stack([it[k] for it in items]))
+                   for k in items[0]}
+
+    def run_steps(cfg, state, step, ds, n_steps):
+        # one warmup step outside the clock: wall budget compares TRAINING
+        # time, not XLA compile time (both pipelines compile both graphs
+        # once in production)
+        warm = next(batches(ds, 1, cfg.data.batch_size))
+        state, m = step(state, warm)
+        jax.block_until_ready(state.params_g)
+        t0 = time.time()
+        for b in batches(ds, n_steps - 1, cfg.data.batch_size):
+            state, m = step(state, b)
+        jax.block_until_ready(state.params_g)
+        elapsed = time.time() - t0
+        return state, elapsed, {k: float(v) for k, v in m.items()}
+
+    def eval_psnr(cfg, state):
+        ev = build_eval_step(cfg, train_dtype=dtype)
+        ps = []
+        for i in range(min(args.test_subset, len(test_ds))):
+            b = {k: jnp.asarray(v)[None] for k, v in test_ds[i].items()}
+            pred, met = ev(state, b)
+            ps.append(float(met["psnr"][0]))
+        return float(np.mean(ps))
+
+    out = {}
+
+    # ---- staged --------------------------------------------------------
+    spe = max(1, len(half_ds) // args.bs)   # real steps/epoch for the
+    s1 = create_train_state(                # lr schedule
+        g1_cfg, jax.random.key(args.seed),
+        next(batches(half_ds, 1, args.bs)), train_dtype=dtype)
+    st1 = build_train_step(g1_cfg, None, spe, train_dtype=dtype)
+    s1, t_g1, m1 = run_steps(g1_cfg, s1, st1, half_ds, args.g1_steps)
+    print(f"phase1: {args.g1_steps} steps in {t_g1:.1f}s, loss_g={m1['loss_g']:.3f}")
+
+    s2 = create_train_state(
+        base, jax.random.key(args.seed + 1),
+        next(batches(full_ds, 1, args.bs)), train_dtype=dtype)
+    s2 = s2.replace(
+        params_g=graft_global_into_full(s2.params_g, s1.params_g))
+    st2 = build_train_step(base, None, max(1, len(full_ds) // args.bs),
+                           train_dtype=dtype)
+    s2, t_full, m2 = run_steps(base, s2, st2, full_ds, args.full_steps)
+    staged_time = t_g1 + t_full
+    out["staged"] = {
+        "g1_steps": args.g1_steps, "full_steps": args.full_steps,
+        "wall_s": staged_time, "loss_g": m2["loss_g"],
+        "psnr": eval_psnr(base, s2),
+    }
+    print("staged:", json.dumps(out["staged"]))
+
+    # ---- cold, same wall budget ---------------------------------------
+    per_full = t_full / args.full_steps
+    cold_steps = max(args.full_steps, int(staged_time / per_full))
+    s3 = create_train_state(
+        base, jax.random.key(args.seed + 2),
+        next(batches(full_ds, 1, args.bs)), train_dtype=dtype)
+    s3, t_cold, m3 = run_steps(base, s3, st2, full_ds, cold_steps)
+    out["cold"] = {
+        "full_steps": cold_steps, "wall_s": t_cold, "loss_g": m3["loss_g"],
+        "psnr": eval_psnr(base, s3),
+    }
+    print("cold:", json.dumps(out["cold"]))
+    out["staged_beats_cold_psnr"] = out["staged"]["psnr"] > out["cold"]["psnr"]
+    with open(args.json, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({k: v for k, v in out.items() if not isinstance(v, dict)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
