@@ -2,6 +2,7 @@ from p2p_tpu.data.generate import compress_uint8, generate_dataset, generate_pat
 from p2p_tpu.data.pipeline import (
     PairedImageDataset,
     device_prefetch,
+    place_global,
     make_loader,
 )
 from p2p_tpu.data.synthetic import make_synthetic_dataset, synthetic_batch
@@ -13,6 +14,7 @@ __all__ = [
     "PairedImageDataset",
     "make_loader",
     "device_prefetch",
+    "place_global",
     "make_synthetic_dataset",
     "synthetic_batch",
 ]
