@@ -71,6 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--lamb", type=float, default=None,
                    help="L1 weight (dead in the reference — Q3; live here)")
+    p.add_argument("--lambda_vgg", type=float, default=None,
+                   help="VGG perceptual weight (reference 10.0; set 0 when "
+                        "no pretrained VGG asset exists — the random-feature "
+                        "fallback at x10 can destabilize training)")
+    p.add_argument("--lambda_feat", type=float, default=None,
+                   help="feature-matching weight (reference 10.0)")
+    p.add_argument("--lambda_tv", type=float, default=None,
+                   help="total-variation weight (reference 1.0)")
     p.add_argument("--pool_size", type=int, default=None,
                    help="historical-fake pool fed to D (reference "
                         "ImagePool(0) = passthrough); >0 enables a "
@@ -106,7 +114,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     model = over(model, input_nc=args.input_nc, output_nc=args.output_nc,
                  ngf=args.ngf, ndf=args.ndf, n_blocks=args.n_blocks,
                  upsample_mode=args.upsample_mode)
-    loss = over(loss, lambda_l1=args.lamb)
+    loss = over(loss, lambda_l1=args.lamb, lambda_vgg=args.lambda_vgg,
+                lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv)
     optim = over(optim, lr=args.lr, lr_policy=args.lr_policy,
                  lr_decay_iters=args.lr_decay_iters, beta1=args.beta1,
                  niter=args.niter, niter_decay=args.niter_decay)

@@ -212,3 +212,27 @@ def test_video_train_and_infer_cli_end_to_end(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     preds = os.listdir(tmp_path / "pred")
     assert len(preds) == 16  # 2 videos x 8 frames
+
+
+def test_config_from_flags_loss_weights_and_phase():
+    """New round-2 knobs: loss-weight flags map into LossConfig; --phase
+    global rewrites the config via g1_phase_config (family, half res,
+    _g1 name) AFTER other overrides; --mesh accepts a 4th (model) axis."""
+    p = build_parser()
+    args = p.parse_args([
+        "--preset", "pix2pixhd", "--lambda_vgg", "0", "--lambda_feat", "5",
+        "--lambda_tv", "0.5", "--lamb", "10", "--image_size", "64",
+        "--mesh", "2,1,1,2", "--phase", "global", "--name", "exp",
+    ])
+    cfg = config_from_flags(args)
+    assert cfg.loss.lambda_vgg == 0.0
+    assert cfg.loss.lambda_feat == 5.0
+    assert cfg.loss.lambda_tv == 0.5
+    assert cfg.loss.lambda_l1 == 10.0
+    # phase transform applied last: family + halved size + suffixed name
+    assert cfg.model.generator == "pix2pixhd_global"
+    assert cfg.data.image_size == 32
+    # square --image_size override clears the preset's rectangular width
+    assert cfg.data.image_width is None
+    assert cfg.name == "exp_g1"
+    assert cfg.parallel.mesh.model == 2 and cfg.parallel.mesh.data == 2
