@@ -1,4 +1,9 @@
 from p2p_tpu.train.schedules import lambda_rule, make_schedule, PlateauController
+from p2p_tpu.train.graft import (
+    g1_phase_config,
+    graft_global_into_full,
+    load_and_graft_g1,
+)
 from p2p_tpu.train.state import TrainState, create_train_state
 from p2p_tpu.train.step import build_eval_step, build_train_step
 from p2p_tpu.train.video_step import (
@@ -13,6 +18,9 @@ __all__ = [
     "make_schedule",
     "PlateauController",
     "TrainState",
+    "g1_phase_config",
+    "graft_global_into_full",
+    "load_and_graft_g1",
     "create_train_state",
     "build_train_step",
     "build_eval_step",
