@@ -31,6 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tile size; -1 disables tiling (whole images)")
     p.add_argument("--img_format", type=str, default="png",
                    help="accepted for parity; outputs are always png")
+    p.add_argument("--min_std", type=float, default=0.0,
+                   help="drop near-constant patches (uint8 std below this); "
+                        "flat tiles blow up per-sample-norm backward passes")
     p.add_argument("--upsampling", type=int, default=0,
                    help="nearest-upsample every source by this factor (>0)")
     return p
@@ -47,6 +50,7 @@ def main(argv=None) -> int:
         bits=args.bit_size,
         upsample=args.upsampling,
         workers=args.pool_size,
+        min_std=args.min_std,
     )
     print(f"wrote {n} paired patches to {args.target_dataset_folder}/{args.split}")
     return 0

@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="feature-matching weight (reference 10.0)")
     p.add_argument("--lambda_tv", type=float, default=None,
                    help="total-variation weight (reference 1.0)")
+    p.add_argument("--grad_clip", type=float, default=None,
+                   help="global-norm gradient clipping (0 = off; guards "
+                        "per-sample-norm backward blowups on degenerate "
+                        "images — see train/state.py)")
     p.add_argument("--pool_size", type=int, default=None,
                    help="historical-fake pool fed to D (reference "
                         "ImagePool(0) = passthrough); >0 enables a "
@@ -118,7 +122,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                 lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv)
     optim = over(optim, lr=args.lr, lr_policy=args.lr_policy,
                  lr_decay_iters=args.lr_decay_iters, beta1=args.beta1,
-                 niter=args.niter, niter_decay=args.niter_decay)
+                 niter=args.niter, niter_decay=args.niter_decay,
+                 grad_clip=args.grad_clip)
     data = over(data, dataset=args.dataset, direction=args.direction,
                 batch_size=args.batch_size, image_size=args.image_size,
                 image_width=args.image_width,

@@ -89,6 +89,10 @@ class OptimConfig:
     # Fix Q1: the reference's optimizer_c holds net_d's params so net_c
     # never trains. True wires C's optimizer to C (intended behavior).
     train_compression_net: bool = True
+    # Global-norm gradient clipping (0 = off, reference parity). The guard
+    # for per-sample-norm backward blowups on degenerate (near-constant)
+    # images — see train/state.py:make_optimizers.
+    grad_clip: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

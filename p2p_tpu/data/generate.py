@@ -59,8 +59,18 @@ def generate_patches(
     max_patches: int = 100,
     bits: int = 3,
     upsample: int = 0,
+    min_std: float = 0.0,
 ) -> int:
-    """Tile one source image into paired patches. Returns patches written."""
+    """Tile one source image into paired patches. Returns patches written.
+
+    ``min_std`` (uint8 units) drops near-constant patches. Degenerate tiles
+    (flat sky, solid fills) are not just useless training signal — under
+    per-sample InstanceNorm a constant image has var≈0 in EVERY layer, and
+    each norm's backward amplifies cotangents by rsqrt(eps)≈316; ~20
+    stacked norms overflow f32 to inf in one step (identical math in torch
+    InstanceNorm2d). Filtering at the source is the principled guard;
+    OptimConfig.grad_clip is the belt-and-braces one.
+    """
     img = Image.open(src_path).convert("RGB")
     if upsample > 0:
         # nearest x|upsample| of EVERY source (generate_dataset.py:60-64)
@@ -73,7 +83,11 @@ def generate_patches(
     else:
         if arr.shape[0] < crop_size or arr.shape[1] < crop_size:
             return 0
-        tiles = _tile(arr, crop_size)[:max_patches]
+        tiles = _tile(arr, crop_size)
+        if min_std > 0:
+            tiles = [t for t in tiles
+                     if float(t.astype(np.float32).std()) >= min_std]
+        tiles = tiles[:max_patches]
     stem = os.path.splitext(os.path.basename(src_path))[0]
     for i, patch in enumerate(tiles):
         name = f"{stem}_{i:04d}.png"
@@ -91,6 +105,7 @@ def generate_dataset(
     bits: int = 3,
     upsample: int = 0,
     workers: int = 0,
+    min_std: float = 0.0,
 ) -> int:
     """Generate <out>/<split>/{a,b}/ from every image under src_dir."""
     a_dir = os.path.join(out_dir, split, "a")
@@ -102,7 +117,8 @@ def generate_dataset(
     sources = sorted(
         os.path.join(src_dir, f) for f in os.listdir(src_dir) if is_image_file(f)
     )
-    args = [(s, a_dir, b_dir, crop_size, max_patches, bits, upsample) for s in sources]
+    args = [(s, a_dir, b_dir, crop_size, max_patches, bits, upsample,
+             min_std) for s in sources]
     if workers and len(sources) > 1:
         with ProcessPoolExecutor(max_workers=workers) as pool:
             counts = list(pool.map(_gen_star, args))

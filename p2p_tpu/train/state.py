@@ -45,16 +45,29 @@ class TrainState(struct.PyTreeNode):
 
 def make_optimizers(cfg: Config, steps_per_epoch: int):
     """Three Adam optimizers with the reference hyperparameters
-    (lr=2e-4, β=(0.5, 0.999) — train.py:241-243) on the configured schedule."""
+    (lr=2e-4, β=(0.5, 0.999) — train.py:241-243) on the configured schedule.
+
+    ``OptimConfig.grad_clip > 0`` prepends global-norm clipping — off by
+    default (the reference has none), but the practical guard against
+    per-sample-norm gradient blowups: a near-constant image makes EVERY
+    InstanceNorm in its sample amplify backward cotangents by
+    rsqrt(eps) ≈ 316, and ~20 stacked norms overflow f32 (inf) in one
+    step. torch's InstanceNorm2d has the identical failure math.
+    """
     from p2p_tpu.train.schedules import make_schedule
 
     def make_one():
         sched = make_schedule(cfg.optim, steps_per_epoch, cfg.train.epoch_count)
-        return optax.inject_hyperparams(
+        adam = optax.inject_hyperparams(
             lambda learning_rate: optax.adam(
                 learning_rate, b1=cfg.optim.beta1, b2=cfg.optim.beta2
             )
         )(learning_rate=sched)
+        if cfg.optim.grad_clip > 0:
+            return optax.chain(
+                optax.clip_by_global_norm(cfg.optim.grad_clip), adam
+            )
+        return adam
 
     return make_one(), make_one(), make_one()
 

@@ -55,6 +55,9 @@ def main(argv=None) -> int:
     ap.add_argument("--test_frac", type=float, default=0.15)
     ap.add_argument("--max_patches", type=int, default=24)
     ap.add_argument("--upsampling", type=int, default=0)
+    ap.add_argument("--min_std", type=float, default=4.0,
+                    help="drop near-constant tiles (flat sky textures); "
+                        "see p2p_tpu.data.generate docstring")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -92,6 +95,7 @@ def main(argv=None) -> int:
             "--crop_size", str(args.crop),
             "--max_patches", str(args.max_patches),
             "--upsampling", str(args.upsampling),
+            "--min_std", str(args.min_std),
         ])
         if rc:
             return rc

@@ -226,3 +226,36 @@ def test_fallback_loader_epochs_and_infinite_stream(tmp_path, monkeypatch):
     inf = make_loader(ds, batch_size=2, shuffle=True, seed=3, num_epochs=None)
     grabbed = [next(inf) for _ in range(10)]  # > one epoch without raising
     assert grabbed[0]["input"].shape == (2, 16, 16, 3)
+
+
+def test_generate_dataset_min_std_filters_flat_tiles(tmp_path):
+    """Near-constant tiles are dropped with min_std (they detonate
+    per-sample-norm backward passes — see data/generate.py docstring)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    img = np.zeros((64, 128, 3), np.uint8)
+    img[:, 64:] = np.random.default_rng(0).integers(
+        0, 256, (64, 64, 3)).astype(np.uint8)   # left half flat, right noisy
+    Image.fromarray(img).save(src / "half.png")
+    out_all = generate_dataset(str(src), str(tmp_path / "all"), crop_size=64)
+    out_filt = generate_dataset(str(src), str(tmp_path / "filt"),
+                                crop_size=64, min_std=4.0)
+    assert out_all == 2 and out_filt == 1
+
+
+def test_grad_clip_optimizer_bounds_update():
+    """OptimConfig.grad_clip chains global-norm clipping before Adam."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from p2p_tpu.core.config import Config, OptimConfig
+    from p2p_tpu.train.state import make_optimizers
+
+    cfg = Config(optim=OptimConfig(grad_clip=1.0))
+    opt, _, _ = make_optimizers(cfg, steps_per_epoch=1)
+    params = {"w": jnp.zeros(4)}
+    st = opt.init(params)
+    giant = {"w": jnp.full(4, 1e30)}
+    ups, _ = opt.update(giant, st, params)
+    assert np.isfinite(np.asarray(ups["w"])).all()
