@@ -102,7 +102,7 @@ def main() -> None:
     img_per_sec = bs * scan_k * n_calls / elapsed
     baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 pix2pix
     comparable = on_tpu and img == 256 and preset in (
-        "facades", "edges2shoes_dp"
+        "facades", "facades_int8", "edges2shoes_dp"
     )
     dims = f"{img}x{wid}" if wid else f"{img}px"
     record = {

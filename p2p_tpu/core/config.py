@@ -59,6 +59,26 @@ class ModelConfig:
     upsample_mode: str = "deconv"
     init_type: str = "normal"   # normal | xavier | kaiming | orthogonal
     init_gain: float = 0.02
+    # int8 QAT path (ops/int8.py): run the MXU-dominant inner convs of G
+    # and D as s8×s8→s32 MXU convolutions (forward + both backward
+    # contractions) with dynamic symmetric scales. The 3/6-channel stems
+    # and the image-producing heads stay bf16 (HBM-bound + quality
+    # critical). v5e: 2× MXU peak vs bf16. Composes with "unet"
+    # (deconv upsampling) generators and non-spectral-norm
+    # discriminators; other combinations ignore the flag.
+    int8: bool = False
+    # Extend int8 to the generator too. Off by default: measured on v5e,
+    # the U-Net's bf16 convs already run near MXU peak fused with their
+    # norms/activations, and the int8 wgrad's slice materialization at
+    # 128²+ spatial costs more than the MXU gain — int8 pays on the
+    # discriminator (wide stride-1/2 convs at ≤65² spatial), where all
+    # three contractions hit the doubled int8 MXU rate.
+    int8_generator: bool = False
+    # With int8_generator: also switch the U-Net decoder deconvs to the
+    # quantized subpixel form (QuantSubpixelDeconv). Measured a net loss
+    # on v5e (interleave + large-spatial wgrad slices); kept reachable
+    # for other chips/shapes.
+    int8_decoder: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +211,22 @@ _register(
         model=ModelConfig(generator="unet", ngf=64, num_D=1, n_layers_D=3,
                           use_spectral_norm=False, use_compression_net=False,
                           use_dropout=True),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        data=DataConfig(dataset="facades", image_size=256, batch_size=1),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+    )
+)
+
+# facades on the int8 QAT MXU path (ops/int8.py): identical architecture
+# and losses; the inner G/D convs run s8×s8→s32 on the MXU (2× peak on
+# v5e) with dynamic symmetric scales, stems/heads bf16.
+_register(
+    Config(
+        name="facades_int8",
+        model=ModelConfig(generator="unet", ngf=64, num_D=1, n_layers_D=3,
+                          use_spectral_norm=False, use_compression_net=False,
+                          use_dropout=True, int8=True),
         loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
                         lambda_l1=100.0),
         data=DataConfig(dataset="facades", image_size=256, batch_size=1),

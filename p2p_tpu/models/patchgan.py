@@ -51,10 +51,21 @@ class _PlainConv(nn.Module):
     features: int
     stride: int
     padding: int = 2
+    # int8 QAT MXU path (ops/int8.py) — set by NLayerDiscriminator on
+    # its wide inner convs only.
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x):
+        if self.int8:
+            from p2p_tpu.ops.int8 import QuantConv
+
+            return QuantConv(
+                self.features, kernel_size=4, strides=self.stride,
+                padding=self.padding, dtype=self.dtype,
+                kernel_init=normal_init(), name="Conv_0",
+            )(x)
         return save_conv_out(nn.Conv(
             self.features,
             kernel_size=(4, 4),
@@ -71,6 +82,10 @@ class NLayerDiscriminator(nn.Module):
     use_spectral_norm: bool = True
     use_sigmoid: bool = False
     get_interm_feat: bool = True
+    # int8 QAT path for the wide inner convs (stages 1..n_layers); the
+    # 6-ch stem and the 1-ch head stay bf16. Ignored when spectral norm
+    # is on (the power iteration needs the true bf16 weight).
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -87,7 +102,8 @@ class NLayerDiscriminator(nn.Module):
                     features, kernel_size=4, stride=stride, padding=2, dtype=self.dtype
                 )(y)
             else:
-                y = _PlainConv(features, stride=stride, dtype=self.dtype)(y)
+                y = _PlainConv(features, stride=stride, int8=self.int8,
+                               dtype=self.dtype)(y)
             return leaky_relu_y(y, 0.2)
 
         for _ in range(1, self.n_layers):
@@ -116,6 +132,7 @@ class MultiscaleDiscriminator(nn.Module):
     use_spectral_norm: bool = True
     use_sigmoid: bool = False
     get_interm_feat: bool = True
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -131,6 +148,7 @@ class MultiscaleDiscriminator(nn.Module):
                 use_spectral_norm=self.use_spectral_norm,
                 use_sigmoid=self.use_sigmoid,
                 get_interm_feat=self.get_interm_feat,
+                int8=self.int8,
                 dtype=self.dtype,
                 name=f"scale{self.num_D - 1 - i}",
             )

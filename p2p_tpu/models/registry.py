@@ -42,6 +42,9 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
         return UNetGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm,
             use_dropout=cfg.use_dropout, upsample_mode=cfg.upsample_mode,
+            int8=(cfg.int8 and cfg.int8_generator
+                  and cfg.upsample_mode == "deconv"),
+            int8_decoder=cfg.int8_decoder,
             dtype=dtype,
         )
     if cfg.generator == "resnet":
@@ -81,6 +84,7 @@ def define_D(cfg: ModelConfig, dtype=None) -> nn.Module:
         num_D=cfg.num_D,
         use_spectral_norm=cfg.use_spectral_norm,
         get_interm_feat=cfg.get_interm_feat,
+        int8=cfg.int8 and not cfg.use_spectral_norm,
         dtype=dtype,
     )
 

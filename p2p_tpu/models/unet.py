@@ -48,6 +48,13 @@ class UNetGenerator(nn.Module):
     # "resize": nearest-resize + conv k3 (no checkerboard risk; 2.25×
     #   decoder FLOPs).
     upsample_mode: str = "deconv"
+    # int8 QAT MXU path (ops/int8.py) for the encoder convs (all except
+    # the 3-ch stem down0). int8_decoder additionally switches the
+    # decoder deconvs (except the image head up0) to the quantized
+    # subpixel form — measured a net loss on v5e, kept as an option.
+    # Requires upsample_mode == "deconv".
+    int8: bool = False
+    int8_decoder: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -66,7 +73,14 @@ class UNetGenerator(nn.Module):
         num_downs = min(self.num_downs, pow2_levels(x.shape[1]),
                         pow2_levels(x.shape[2]))
 
-        def down_conv(y, features, name):
+        def down_conv(y, features, name, int8=False):
+            if int8:
+                from p2p_tpu.ops.int8 import QuantConv
+
+                return QuantConv(
+                    features, kernel_size=4, strides=2, padding=1,
+                    dtype=self.dtype, kernel_init=normal_init(), name=name,
+                )(y)
             return save_conv_out(nn.Conv(
                 features, kernel_size=(4, 4), strides=(2, 2), padding=1,
                 dtype=self.dtype, kernel_init=normal_init(), name=name,
@@ -80,7 +94,8 @@ class UNetGenerator(nn.Module):
         for i, f in enumerate(feats):
             if i > 0:
                 y = leaky_relu_y(y, 0.2)
-            y = down_conv(y, f, name=f"down{i}")
+            y = down_conv(y, f, name=f"down{i}",
+                          int8=self.int8 and i > 0)
             # no norm on the outermost and innermost encoder convs
             if 0 < i < num_downs - 1:
                 y = mk()(y)
@@ -95,11 +110,24 @@ class UNetGenerator(nn.Module):
                     f, dtype=self.dtype, name=f"up{i}",
                 )(y)
             elif self.upsample_mode == "deconv":
-                y = save_conv_out(nn.ConvTranspose(
-                    f, kernel_size=(4, 4), strides=(2, 2), padding="SAME",
-                    dtype=self.dtype, kernel_init=normal_init(),
-                    name=f"up{i}",
-                )(y))
+                if self.int8 and self.int8_decoder and i > 0:
+                    # conv-k2s1 subpixel form: the ConvTranspose family
+                    # member whose int8 lowering wins in all three
+                    # contractions (see ops/int8.py). Off by default:
+                    # measured on v5e the interleave + large-spatial
+                    # wgrad slices cost more than the MXU gain.
+                    from p2p_tpu.ops.int8 import QuantSubpixelDeconv
+
+                    y = QuantSubpixelDeconv(
+                        f, dtype=self.dtype,
+                        kernel_init=normal_init(), name=f"up{i}",
+                    )(y)
+                else:
+                    y = save_conv_out(nn.ConvTranspose(
+                        f, kernel_size=(4, 4), strides=(2, 2),
+                        padding="SAME", dtype=self.dtype,
+                        kernel_init=normal_init(), name=f"up{i}",
+                    )(y))
             elif self.upsample_mode == "resize":
                 y = UpsampleConvLayer(
                     f, kernel_size=3, upsample=2, dtype=self.dtype,

@@ -110,6 +110,22 @@ def upsample_nearest(x: jax.Array, factor: int) -> jax.Array:
     return x.reshape(n, h * factor, w * factor, c)
 
 
+def subpixel_interleave(out: jax.Array, features: int) -> jax.Array:
+    """The shifted depth-to-space of SubpixelDeconv: maps the k2-s1 conv
+    output (N, H+1, W+1, 4F) to (N, 2H, 2W, F) via
+    ``y[2i+u, 2j+v] = out[i+u, j+v, (u,v)]``. Shared by the bf16 and
+    int8 (ops/int8.py QuantSubpixelDeconv) variants."""
+    n, h1, w1, c4 = out.shape
+    h, w, f = h1 - 1, w1 - 1, features
+    out = out.reshape(n, h1, w1, 2, 2, f)
+    rows = []
+    for u in range(2):
+        cols = [out[:, u:u + h, v:v + w, u, v] for v in range(2)]
+        rows.append(jnp.stack(cols, axis=3))          # (N,H,W,2,F)
+    y = jnp.stack(rows, axis=2)                       # (N,H,2,W,2,F)
+    return y.reshape(n, 2 * h, 2 * w, f)
+
+
 class SubpixelDeconv(nn.Module):
     """ConvTranspose(k4, s2, 'SAME') re-expressed as conv(k2, s1) + shifted
     depth-to-space — the TPU-friendly learned 2× upsample.
@@ -144,14 +160,7 @@ class SubpixelDeconv(nn.Module):
             dtype=self.dtype, kernel_init=self.kernel_init,
         )(x)                                    # (N, H+1, W+1, 4F)
         out = save_conv_out(out)
-        out = out.reshape(n, h + 1, w + 1, 2, 2, f)
-        # y[2i+u, 2j+v] = out[i+u, j+v, u, v]
-        rows = []
-        for u in range(2):
-            cols = [out[:, u:u + h, v:v + w, u, v] for v in range(2)]
-            rows.append(jnp.stack(cols, axis=3))          # (N,H,W,2,F)
-        y = jnp.stack(rows, axis=2)                       # (N,H,2,W,2,F)
-        return y.reshape(n, 2 * h, 2 * w, f)
+        return subpixel_interleave(out, self.features)
 
 
 class UpsampleConvLayer(nn.Module):

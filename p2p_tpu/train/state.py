@@ -43,6 +43,24 @@ class TrainState(struct.PyTreeNode):
     pool_n: Optional[jax.Array] = None
 
 
+def _zero_nonfinite() -> optax.GradientTransformation:
+    """Replace non-finite (inf/NaN) gradient leaves' bad entries with 0,
+    so a single blown-up sample is dropped rather than poisoning the
+    Adam moments forever."""
+
+    def update(updates, state, params=None):
+        del params
+        updates = jax.tree_util.tree_map(
+            lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)),
+            updates,
+        )
+        return updates, state
+
+    return optax.GradientTransformation(
+        lambda params: optax.EmptyState(), update
+    )
+
+
 def make_optimizers(cfg: Config, steps_per_epoch: int):
     """Three Adam optimizers with the reference hyperparameters
     (lr=2e-4, β=(0.5, 0.999) — train.py:241-243) on the configured schedule.
@@ -58,16 +76,28 @@ def make_optimizers(cfg: Config, steps_per_epoch: int):
 
     def make_one():
         sched = make_schedule(cfg.optim, steps_per_epoch, cfg.train.epoch_count)
-        adam = optax.inject_hyperparams(
-            lambda learning_rate: optax.adam(
+        clip = cfg.optim.grad_clip
+
+        def inner(learning_rate):
+            adam = optax.adam(
                 learning_rate, b1=cfg.optim.beta1, b2=cfg.optim.beta2
             )
-        )(learning_rate=sched)
-        if cfg.optim.grad_clip > 0:
-            return optax.chain(
-                optax.clip_by_global_norm(cfg.optim.grad_clip), adam
-            )
-        return adam
+            if clip > 0:
+                # Non-finite grads must be zeroed BEFORE the clip: with
+                # an inf gradient clip_by_global_norm scales by
+                # max_norm/inf = 0 and inf·0 = NaN updates — the exact
+                # blowup this guard exists for (optax.zero_nans only
+                # handles NaN, not inf). Built INSIDE inject_hyperparams
+                # so the top-level opt state keeps .hyperparams
+                # (Trainer.current_lr, checkpoint layout).
+                return optax.chain(
+                    _zero_nonfinite(),
+                    optax.clip_by_global_norm(clip),
+                    adam,
+                )
+            return adam
+
+        return optax.inject_hyperparams(inner)(learning_rate=sched)
 
     return make_one(), make_one(), make_one()
 

@@ -256,6 +256,15 @@ def test_grad_clip_optimizer_bounds_update():
     opt, _, _ = make_optimizers(cfg, steps_per_epoch=1)
     params = {"w": jnp.zeros(4)}
     st = opt.init(params)
+    # clipping lives INSIDE inject_hyperparams: the top-level state must
+    # keep .hyperparams (Trainer.current_lr, checkpoint layout)
+    assert hasattr(st, "hyperparams") and "learning_rate" in st.hyperparams
     giant = {"w": jnp.full(4, 1e30)}
-    ups, _ = opt.update(giant, st, params)
+    ups, st2 = opt.update(giant, st, params)
+    assert np.isfinite(np.asarray(ups["w"])).all()
+    # an actually-inf gradient (the per-sample-norm blowup this guard is
+    # for) must also produce finite updates — inf·(max_norm/inf)=NaN
+    # without the non-finite pre-filter
+    blown = {"w": jnp.full(4, jnp.inf)}
+    ups, _ = opt.update(blown, st2, params)
     assert np.isfinite(np.asarray(ups["w"])).all()

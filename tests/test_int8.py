@@ -1,0 +1,146 @@
+"""int8 QAT conv path: exact parity with the float conv VJP on
+integer-valued tensors (where symmetric quantization is lossless), plus
+tolerance parity and param-tree compatibility of the flax drop-ins."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_tpu.ops.int8 import (
+    QuantConv,
+    QuantConvTranspose,
+    absmax_scale,
+    int8_conv,
+    quantize_int8,
+)
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _grid_ints(rng, shape, scale=1.0, channel_axis=None):
+    """Integer-valued tensor in [-127,127]·scale with ±127 present, so
+    absmax quantization reproduces it exactly. ``channel_axis`` pins
+    ±127 in EVERY slice along that axis (equal per-channel scales — the
+    condition under which the folded dgrad cotangent stays on the
+    integer grid, see ops/int8.py)."""
+    v = rng.integers(-127, 128, size=shape).astype(np.float32)
+    if channel_axis is None:
+        v.flat[0] = 127.0
+    else:
+        idx = [0] * len(shape)
+        idx[channel_axis] = slice(None)
+        v[tuple(idx)] = 127.0
+    return jnp.asarray(v * scale)
+
+
+def _float_conv(x, w, strides, padding, lhs_dil=(1, 1)):
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, DN)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        lhs_dilation=lhs_dil, dimension_numbers=dn,
+    )
+
+
+CASES = [
+    # (k, strides, padding, lhs_dil, H)
+    (3, (1, 1), ((1, 1), (1, 1)), (1, 1), 9),
+    (4, (2, 2), ((1, 1), (1, 1)), (1, 1), 12),
+    (4, (2, 2), ((2, 2), (2, 2)), (1, 1), 13),   # odd input, ref padw=2
+    (4, (1, 1), ((2, 2), (2, 2)), (1, 1), 9),
+    (4, (1, 1), ((2, 2), (2, 2)), (2, 2), 6),    # transposed-conv form
+    # outputs ≥ 16² positions: exercises the int8 dot_general wgrad
+    # branch (ho·wo >= 256 guard in ops/int8.py), s1 and s2
+    (3, (1, 1), ((1, 1), (1, 1)), (1, 1), 20),
+    (4, (2, 2), ((1, 1), (1, 1)), (1, 1), 36),
+]
+
+
+@pytest.mark.parametrize("k,strides,padding,lhs_dil,H", CASES)
+def test_int8_conv_exact_vs_float_on_integer_grids(k, strides, padding,
+                                                   lhs_dil, H):
+    rng = np.random.default_rng(0)
+    x = _grid_ints(rng, (2, H, H, 8), scale=0.5)
+    # equal per-channel absmax → the folded dgrad cotangent stays on the
+    # integer grid too (see ops/int8.py docstring)
+    w = _grid_ints(rng, (k, k, 8, 16), scale=0.25, channel_axis=3)
+
+    y8 = int8_conv(x, w, strides, padding, lhs_dil)
+    yf = _float_conv(x, w, strides, padding, lhs_dil)
+    np.testing.assert_allclose(np.asarray(y8), np.asarray(yf), rtol=1e-6)
+
+    ct = _grid_ints(rng, yf.shape, scale=2.0)
+    _, vjp8 = jax.vjp(lambda a, b: int8_conv(a, b, strides, padding, lhs_dil),
+                      x, w)
+    _, vjpf = jax.vjp(lambda a, b: _float_conv(a, b, strides, padding,
+                                               lhs_dil), x, w)
+    dx8, dw8 = vjp8(ct)
+    dxf, dwf = vjpf(ct)
+    np.testing.assert_allclose(np.asarray(dx8), np.asarray(dxf), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw8), np.asarray(dwf), rtol=1e-5)
+
+
+def test_int8_conv_tolerance_on_random_normals():
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (2, 16, 16, 32))
+    w = jax.random.normal(jax.random.key(1), (4, 4, 32, 64)) * 0.1
+    y8 = int8_conv(x, w, (2, 2), ((1, 1), (1, 1)))
+    yf = _float_conv(x, w, (2, 2), ((1, 1), (1, 1)))
+    rel = (jnp.linalg.norm(y8 - yf) / jnp.linalg.norm(yf)).item()
+    assert rel < 0.02, rel
+
+
+def test_quantize_roundtrip_and_scale_shapes():
+    rng = np.random.default_rng(1)
+    x = _grid_ints(rng, (3, 4, 4, 5), scale=0.125)
+    s = absmax_scale(x)
+    assert s.shape == ()
+    np.testing.assert_allclose(
+        np.asarray(quantize_int8(x, s), np.float32) * np.asarray(s),
+        np.asarray(x), rtol=1e-6)
+    sw = absmax_scale(x, axis=(0, 1, 2))
+    assert sw.shape == (1, 1, 1, 5)
+
+
+def test_quant_subpixel_deconv_matches_subpixel():
+    from p2p_tpu.ops.conv import SubpixelDeconv
+    from p2p_tpu.ops.int8 import QuantSubpixelDeconv
+
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 16))
+    ref = SubpixelDeconv(features=12)
+    mod = QuantSubpixelDeconv(features=12)
+    pr = ref.init(jax.random.key(1), x)
+    p = mod.init(jax.random.key(1), x)
+    assert jax.tree_util.tree_structure(p) == jax.tree_util.tree_structure(pr)
+    y = mod.apply(pr, x)
+    yr = ref.apply(pr, x)
+    assert y.shape == yr.shape == (2, 16, 16, 12)
+    rel = (jnp.linalg.norm(y - yr) / jnp.linalg.norm(yr)).item()
+    assert rel < 0.03, rel
+
+
+@pytest.mark.parametrize("cls,ref_cls,kw", [
+    (QuantConv, None, {}),
+    (QuantConvTranspose, None, {}),
+])
+def test_quant_modules_param_compat_and_close(cls, ref_cls, kw):
+    from flax import linen as nn
+
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 12))
+    if cls is QuantConv:
+        mod = QuantConv(features=24, kernel_size=4, strides=2, padding=1)
+        ref = nn.Conv(24, (4, 4), strides=(2, 2), padding=1)
+    else:
+        mod = QuantConvTranspose(features=24, kernel_size=4, strides=2)
+        ref = nn.ConvTranspose(24, (4, 4), strides=(2, 2), padding="SAME")
+    p = mod.init(jax.random.key(1), x)
+    pr = ref.init(jax.random.key(1), x)
+    # identical param trees (names AND shapes) → checkpoints interchange
+    assert jax.tree_util.tree_structure(p) == jax.tree_util.tree_structure(pr)
+    assert [a.shape for a in jax.tree_util.tree_leaves(p)] == \
+           [a.shape for a in jax.tree_util.tree_leaves(pr)]
+    y = mod.apply(pr, x)          # same weights through both paths
+    yr = ref.apply(pr, x)
+    assert y.shape == yr.shape
+    rel = (jnp.linalg.norm(y - yr) / jnp.linalg.norm(yr)).item()
+    assert rel < 0.03, rel
