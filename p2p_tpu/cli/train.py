@@ -45,6 +45,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "ConvTranspose; resize = nearest+conv)")
     p.add_argument("--augment", action="store_true", default=None,
                    help="paired resize-286/random-crop/flip augmentation")
+    p.add_argument("--int8", action="store_true", default=None,
+                   help="int8 QAT MXU path for the discriminator's inner "
+                        "convs (ops/int8.py; ~1.1x step on v5e); "
+                        "--int8_generator extends it to the U-Net G")
+    p.add_argument("--int8_generator", action="store_true", default=None,
+                   help="extend --int8 to the generator convs (measured "
+                        "slower on v5e at 256^2; see ModelConfig)")
     # --- reference flags (train.py:133-157), same names/defaults ---------
     p.add_argument("--dataset", type=str, default=None, help="facades")
     p.add_argument("--name", type=str, default=None, help="training name")
@@ -79,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="feature-matching weight (reference 10.0)")
     p.add_argument("--lambda_tv", type=float, default=None,
                    help="total-variation weight (reference 1.0)")
+    p.add_argument("--lambda_sobel", type=float, default=None,
+                   help="Sobel edge-L1 weight (the reference's commented "
+                        "edge experiment, train.py:362-363; 0 = off)")
+    p.add_argument("--sobel_warmup_epochs", type=int, default=None,
+                   help="ramp the sobel weight linearly over this many "
+                        "epochs (reference train.py:445-448; 0 = constant)")
     p.add_argument("--grad_clip", type=float, default=None,
                    help="global-norm gradient clipping (0 = off; guards "
                         "per-sample-norm backward blowups on degenerate "
@@ -117,9 +130,12 @@ def config_from_flags(args: argparse.Namespace) -> Config:
 
     model = over(model, input_nc=args.input_nc, output_nc=args.output_nc,
                  ngf=args.ngf, ndf=args.ndf, n_blocks=args.n_blocks,
-                 upsample_mode=args.upsample_mode)
+                 upsample_mode=args.upsample_mode, int8=args.int8,
+                 int8_generator=args.int8_generator)
     loss = over(loss, lambda_l1=args.lamb, lambda_vgg=args.lambda_vgg,
-                lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv)
+                lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv,
+                lambda_sobel=args.lambda_sobel,
+                sobel_warmup_epochs=args.sobel_warmup_epochs)
     optim = over(optim, lr=args.lr, lr_policy=args.lr_policy,
                  lr_decay_iters=args.lr_decay_iters, beta1=args.beta1,
                  niter=args.niter, niter_decay=args.niter_decay,

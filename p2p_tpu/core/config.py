@@ -63,9 +63,10 @@ class ModelConfig:
     # and D as s8×s8→s32 MXU convolutions (forward + both backward
     # contractions) with dynamic symmetric scales. The 3/6-channel stems
     # and the image-producing heads stay bf16 (HBM-bound + quality
-    # critical). v5e: 2× MXU peak vs bf16. Composes with "unet"
-    # (deconv upsampling) generators and non-spectral-norm
-    # discriminators; other combinations ignore the flag.
+    # critical). v5e: 2× MXU peak vs bf16. Applies to all discriminator
+    # families (spectral norm composes: the power iteration tracks the
+    # true f32 weight, only w/σ is quantized) and — via int8_generator —
+    # to "unet" (deconv upsampling) generators.
     int8: bool = False
     # Extend int8 to the generator too. Off by default: measured on v5e,
     # the U-Net's bf16 convs already run near MXU peak fused with their
@@ -95,6 +96,14 @@ class LossConfig:
     # (networks.py:26 — no ImageNet mean/std). Changes loss scale; keep
     # faithful by default.
     vgg_imagenet_norm: bool = False
+    # Sobel edge L1 between fake and real — the reference's commented-out
+    # edge experiment (train.py:307,313,362-363; sobelLayer at
+    # networks.py:852). Dead there (0 here) but live behind this weight.
+    lambda_sobel: float = 0.0
+    # The reference's commented warmup schedule (train.py:445-448):
+    # effective sobel weight ramps linearly to lambda_sobel over this
+    # many epochs (``100/20*epoch`` shape); 0 = constant weight.
+    sobel_warmup_epochs: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -83,8 +83,9 @@ class NLayerDiscriminator(nn.Module):
     use_sigmoid: bool = False
     get_interm_feat: bool = True
     # int8 QAT path for the wide inner convs (stages 1..n_layers); the
-    # 6-ch stem and the 1-ch head stay bf16. Ignored when spectral norm
-    # is on (the power iteration needs the true bf16 weight).
+    # 6-ch stem and the 1-ch head stay bf16. Composes with spectral
+    # norm: the power iteration tracks the true f32 weight and only the
+    # normalized w/σ is quantized (SpectralConv.int8).
     int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
@@ -99,7 +100,8 @@ class NLayerDiscriminator(nn.Module):
         def inner(y, features, stride):
             if self.use_spectral_norm:
                 y = SpectralConv(
-                    features, kernel_size=4, stride=stride, padding=2, dtype=self.dtype
+                    features, kernel_size=4, stride=stride, padding=2,
+                    int8=self.int8, dtype=self.dtype
                 )(y)
             else:
                 y = _PlainConv(features, stride=stride, int8=self.int8,

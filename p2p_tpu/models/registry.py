@@ -84,7 +84,7 @@ def define_D(cfg: ModelConfig, dtype=None) -> nn.Module:
         num_D=cfg.num_D,
         use_spectral_norm=cfg.use_spectral_norm,
         get_interm_feat=cfg.get_interm_feat,
-        int8=cfg.int8 and not cfg.use_spectral_norm,
+        int8=cfg.int8,
         dtype=dtype,
     )
 

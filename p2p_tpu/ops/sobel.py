@@ -29,7 +29,12 @@ def sobel_edges(img: jax.Array) -> jax.Array:
     dn = ("NHWC", "HWIO", "NHWC")
     gx = jax.lax.conv_general_dilated(x, kx, (1, 1), "SAME", dimension_numbers=dn)
     gy = jax.lax.conv_general_dilated(x, ky, (1, 1), "SAME", dimension_numbers=dn)
-    return jnp.sqrt(gx**2 + gy**2)
+    # eps under the sqrt: d/dg sqrt(gx²+gy²) is 0/0 = NaN on flat
+    # regions (gx=gy=0 — routine for tanh-saturated patches), and this
+    # op is live in the train loss behind lambda_sobel. The reference's
+    # dead sobelLayer has no eps (networks.py:866) — value change is
+    # ≤ sqrt(eps) = 1e-6.
+    return jnp.sqrt(gx**2 + gy**2 + 1e-12)
 
 
 def angular_loss(illum_gt: jax.Array, illum_pred: jax.Array) -> jax.Array:

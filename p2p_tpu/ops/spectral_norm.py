@@ -67,6 +67,12 @@ class SpectralConv(nn.Module):
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
     n_power_iterations: int = 1
+    # int8 QAT path (ops/int8.py) for the conv itself. The power
+    # iteration runs on the TRUE f32 weight (σ must track the real
+    # spectrum); only the normalized kernel w/σ is quantized — the same
+    # "quantize the derived weight" order torch QAT uses for weight-norm
+    # wrappers.
+    int8: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -92,15 +98,24 @@ class SpectralConv(nn.Module):
         kernel_sn = (kernel / sigma).astype(self.dtype or x.dtype)
 
         pad = self.padding
-        if pad:
-            x = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
-        y = jax.lax.conv_general_dilated(
-            x.astype(kernel_sn.dtype),
-            kernel_sn,
-            window_strides=(self.stride, self.stride),
-            padding="VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        if self.int8:
+            from p2p_tpu.ops.int8 import int8_conv
+
+            p = ((pad, pad), (pad, pad))
+            y = int8_conv(
+                x.astype(kernel_sn.dtype), kernel_sn,
+                (self.stride, self.stride), p,
+            )
+        else:
+            if pad:
+                x = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+            y = jax.lax.conv_general_dilated(
+                x.astype(kernel_sn.dtype),
+                kernel_sn,
+                window_strides=(self.stride, self.stride),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros, (self.features,), jnp.float32

@@ -238,6 +238,24 @@ def build_train_step(
                 l_tv = total_variation_loss(fake_b) * L.lambda_tv
                 parts["g_tv"] = l_tv
                 total = total + l_tv
+            if L.lambda_sobel > 0:
+                from p2p_tpu.ops.sobel import sobel_edges
+
+                lam = jnp.float32(L.lambda_sobel)
+                if L.sobel_warmup_epochs > 0:
+                    # reference warmup shape (train.py:445-448):
+                    # weight ramps linearly with the epoch index,
+                    # saturating at lambda_sobel after warmup epochs
+                    epoch = 1 + state.step // max(steps_per_epoch, 1)
+                    lam = lam * jnp.minimum(
+                        epoch.astype(jnp.float32) / L.sobel_warmup_epochs,
+                        1.0,
+                    )
+                l_sobel = jnp.mean(jnp.abs(
+                    sobel_edges(fake_b) - sobel_edges(real_b)
+                )) * lam
+                parts["g_sobel"] = l_sobel
+                total = total + l_sobel
             if L.lambda_l1 > 0:
                 # elementwise diff in the train dtype (bf16 cotangents),
                 # accumulation in f32 — halves the loss-side HBM traffic

@@ -192,7 +192,15 @@ def test_sobel_shapes_and_known_edge():
     assert float(jnp.max(g[:, 1:-1, 1:-1])) == pytest.approx(4.0)
     col = np.asarray(g[0, 2:6, :, 0])
     assert col[:, 3].min() > 0  # edge detected at the step
-    assert np.allclose(col[:, 1], 0)  # flat region
+    assert np.allclose(col[:, 1], 0, atol=1e-5)  # flat (eps under sqrt)
+
+
+def test_sobel_gradient_finite_on_flat_image():
+    """d sqrt(gx²+gy²)/dx is 0/0 on flat regions without the eps — this
+    op is live in the train loss behind lambda_sobel."""
+    flat = jnp.full((1, 8, 8, 3), 0.7)
+    g = jax.grad(lambda im: jnp.sum(sobel_edges(im)))(flat)
+    assert bool(jnp.isfinite(g).all())
 
 
 def test_angular_loss_zero_for_identical_and_90deg():

@@ -426,3 +426,29 @@ def test_lambda_rule_clamped_at_zero():
     ASCENT); the framework clamps at 0."""
     assert float(lambda_rule(400, 1, 100, 100)) == 0.0
     assert float(lambda_rule(199, 1, 100, 100)) > 0.0
+
+
+def test_sobel_loss_term_and_warmup():
+    """lambda_sobel adds a g_sobel term; sobel_warmup_epochs ramps it
+    with the epoch index (reference train.py:445-448 shape)."""
+    import dataclasses
+
+    cfg = tiny_config()
+    cfg = cfg.replace(loss=dataclasses.replace(
+        cfg.loss, lambda_sobel=5.0, sobel_warmup_epochs=4))
+    b = {k: jnp.asarray(v) for k, v in synthetic_batch(2, 32).items()}
+    # steps_per_epoch=1 → epoch index == step+1; weight = 5·min(e/4, 1).
+    # The raw edge-L1 changes as G trains, so compare the FIRST step of a
+    # warmup run against a no-warmup twin from the same init: the ratio
+    # must be the epoch-1 ramp value (1/4).
+    state = create_train_state(cfg, jax.random.key(0), b, 1)
+    step_fn = build_train_step(cfg, None, 1, None, jit=True)
+    state, mw = step_fn(state, b)
+    assert np.isfinite(float(mw["g_sobel"]))
+    cfg0 = cfg.replace(loss=dataclasses.replace(
+        cfg.loss, sobel_warmup_epochs=0))
+    state0 = create_train_state(cfg0, jax.random.key(0), b, 1)
+    step0 = build_train_step(cfg0, None, 1, None, jit=True)
+    _, m0 = step0(state0, b)
+    assert float(mw["g_sobel"]) == pytest.approx(
+        0.25 * float(m0["g_sobel"]), rel=1e-5)
