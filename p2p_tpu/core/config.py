@@ -66,7 +66,8 @@ class ModelConfig:
     # critical). v5e: 2× MXU peak vs bf16. Applies to all discriminator
     # families (spectral norm composes: the power iteration tracks the
     # true f32 weight, only w/σ is quantized) and — via int8_generator —
-    # to "unet" (deconv upsampling) generators.
+    # to the "unet" encoder (deconv mode) and the ResNet-trunk families
+    # (resnet / pix2pixhd / pix2pixhd_global k3-s1 blocks).
     int8: bool = False
     # Extend int8 to the generator too. Off by default: measured on v5e,
     # the U-Net's bf16 convs already run near MXU peak fused with their
@@ -228,8 +229,9 @@ _register(
 )
 
 # facades on the int8 QAT MXU path (ops/int8.py): identical architecture
-# and losses; the inner G/D convs run s8×s8→s32 on the MXU (2× peak on
-# v5e) with dynamic symmetric scales, stems/heads bf16.
+# and losses; the DISCRIMINATOR's inner convs run s8×s8→s32 on the MXU
+# (2× peak on v5e) with dynamic symmetric scales — the generator stays
+# bf16 (int8_generator measured slower at this shape), stems/heads bf16.
 _register(
     Config(
         name="facades_int8",

@@ -43,6 +43,7 @@ def GlobalGenerator(
     norm: str = "instance",
     return_features: bool = False,
     remat: Union[bool, str] = False,
+    int8: bool = False,
     dtype=None,
     name: Optional[str] = None,
 ) -> ResnetGenerator:
@@ -51,7 +52,8 @@ def GlobalGenerator(
     return ResnetGenerator(
         ngf=ngf, n_blocks=n_blocks, out_channels=out_channels,
         n_downsampling=4, norm=norm, max_features=1024,
-        return_features=return_features, remat=remat, dtype=dtype, name=name,
+        return_features=return_features, remat=remat, int8=int8,
+        dtype=dtype, name=name,
     )
 
 
@@ -64,6 +66,8 @@ class Pix2PixHDGenerator(nn.Module):
     n_blocks_local: int = 3
     norm: str = "instance"
     remat: Union[bool, str] = False
+    # int8 MXU path for the G1 trunk + local enhancer ResnetBlocks
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -75,8 +79,8 @@ class Pix2PixHDGenerator(nn.Module):
         x_half = avg_pool_downsample(x)
         g1_feats = GlobalGenerator(
             ngf=self.ngf, n_blocks=self.n_blocks_global, norm=self.norm,
-            return_features=True, remat=self.remat, dtype=self.dtype,
-            name="global",
+            return_features=True, remat=self.remat, int8=self.int8,
+            dtype=self.dtype, name="global",
         )(x_half, train)
 
         # G2 front end on the full-res input, down to half res
@@ -90,7 +94,8 @@ class Pix2PixHDGenerator(nn.Module):
         block_cls = remat_wrap(ResnetBlock, self.remat)
         for i in range(self.n_blocks_local):
             # explicit name: remat wrapping must not change param paths
-            y = block_cls(self.ngf, norm=self.norm, dtype=self.dtype,
+            y = block_cls(self.ngf, norm=self.norm, int8=self.int8,
+                          dtype=self.dtype,
                           name=f"ResnetBlock_{i}")(y, train)
 
         y = UpsampleConvLayer(ngf_local, kernel_size=3, upsample=2,

@@ -47,6 +47,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             int8_decoder=cfg.int8_decoder,
             dtype=dtype,
         )
+    int8_g = cfg.int8 and cfg.int8_generator
     if cfg.generator == "resnet":
         from p2p_tpu.models.resnet_gen import ResnetGenerator
 
@@ -56,6 +57,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             out_channels=cfg.output_nc,
             norm=cfg.norm,
             remat=remat,
+            int8=int8_g,
             dtype=dtype,
         )
     if cfg.generator == "pix2pixhd":
@@ -64,7 +66,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
         return Pix2PixHDGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc,
             n_blocks_global=cfg.n_blocks, norm=cfg.norm,
-            remat=remat, dtype=dtype,
+            remat=remat, int8=int8_g, dtype=dtype,
         )
     if cfg.generator == "pix2pixhd_global":
         # phase 1 of the coarse-to-fine schedule: G1 alone at half res
@@ -72,7 +74,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
 
         return GlobalGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc, n_blocks=cfg.n_blocks,
-            norm=cfg.norm, remat=remat, dtype=dtype,
+            norm=cfg.norm, remat=remat, int8=int8_g, dtype=dtype,
         )
     raise ValueError(f"unknown generator {cfg.generator!r}")
 

@@ -29,18 +29,23 @@ from p2p_tpu.ops.activations import (
 
 class ResnetBlock(nn.Module):
     """reflectpad-conv-norm-relu-reflectpad-conv-norm + identity (no final
-    activation)."""
+    activation). ``int8``: both k3-s1 convs on the int8 MXU path — the
+    stride-1 form where all three quantized contractions win on v5e
+    (ops/int8.py)."""
 
     features: int
     norm: str = "instance"
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
-        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(x)
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+                      dtype=self.dtype)(x)
         y = relu_y(mk()(y))
-        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+                      dtype=self.dtype)(y)
         y = mk()(y)
         return x + y
 
@@ -58,6 +63,10 @@ class ResnetGenerator(nn.Module):
     max_features: Optional[int] = None
     return_features: bool = False
     remat: Union[bool, str] = False
+    # int8 MXU path for the residual trunk's k3-s1 convs (the stem,
+    # stride-2 downs, upsample convs and head stay bf16 — HBM-bound or
+    # quality-critical).
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -78,7 +87,8 @@ class ResnetGenerator(nn.Module):
             # explicit name: remat wrapping must not change param paths
             # (nn.remat's auto-name is 'CheckpointResnetBlock_i', which
             # would silently re-key checkpoints when remat is toggled)
-            y = block_cls(f_trunk, norm=self.norm, dtype=self.dtype,
+            y = block_cls(f_trunk, norm=self.norm, int8=self.int8,
+                          dtype=self.dtype,
                           name=f"ResnetBlock_{i}")(y, train)
 
         for i in reversed(range(self.n_downsampling)):

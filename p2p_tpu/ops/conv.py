@@ -76,12 +76,18 @@ def normal_init(stddev: float = 0.02):
 
 
 class ConvLayer(nn.Module):
-    """ReflectionPad(k//2) + conv. Ref: networks.py:395-405."""
+    """ReflectionPad(k//2) + conv. Ref: networks.py:395-405.
+
+    ``int8`` routes the conv through the int8 MXU path (ops/int8.py);
+    the reflect pad stays outside (the quantized conv pads with zeros
+    only), parameter tree unchanged.
+    """
 
     features: int
     kernel_size: int
     stride: int = 1
     use_bias: bool = True
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
 
@@ -89,6 +95,15 @@ class ConvLayer(nn.Module):
     def __call__(self, x):
         pad = self.kernel_size // 2
         x = reflect_pad_2d(x, pad)
+        if self.int8:
+            from p2p_tpu.ops.int8 import QuantConv
+
+            return QuantConv(
+                self.features, kernel_size=self.kernel_size,
+                strides=self.stride, padding=0, use_bias=self.use_bias,
+                dtype=self.dtype, kernel_init=self.kernel_init,
+                name="Conv_0",
+            )(x)
         return save_conv_out(nn.Conv(
             features=self.features,
             kernel_size=(self.kernel_size, self.kernel_size),

@@ -160,3 +160,21 @@ def test_quant_modules_param_compat_and_close(cls):
     assert y.shape == yr.shape
     rel = (jnp.linalg.norm(y - yr) / jnp.linalg.norm(yr)).item()
     assert rel < 0.03, rel
+
+
+def test_resnet_block_int8_param_compat_and_close():
+    """ResnetBlock(int8=True): same param tree as bf16, close output —
+    the k3-s1 trunk form used by cityscapes/pix2pixHD int8 generators."""
+    from p2p_tpu.models.resnet_gen import ResnetBlock
+
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 32))
+    ref = ResnetBlock(features=32, norm="instance")
+    q = ResnetBlock(features=32, norm="instance", int8=True)
+    v = ref.init(jax.random.key(1), x)
+    vq = q.init(jax.random.key(1), x)
+    assert (jax.tree_util.tree_structure(v) ==
+            jax.tree_util.tree_structure(vq))
+    yr = ref.apply(v, x)
+    yq = q.apply(v, x)
+    rel = (jnp.linalg.norm(yq - yr) / jnp.linalg.norm(yr)).item()
+    assert rel < 0.03, rel
