@@ -92,6 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sobel_warmup_epochs", type=int, default=None,
                    help="ramp the sobel weight linearly over this many "
                         "epochs (reference train.py:445-448; 0 = constant)")
+    p.add_argument("--lambda_angular", type=float, default=None,
+                   help="mean-angular-error weight (the reference's "
+                        "commented experiment, train.py:355-360; 0 = off)")
     p.add_argument("--grad_clip", type=float, default=None,
                    help="global-norm gradient clipping (0 = off; guards "
                         "per-sample-norm backward blowups on degenerate "
@@ -135,7 +138,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     loss = over(loss, lambda_l1=args.lamb, lambda_vgg=args.lambda_vgg,
                 lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv,
                 lambda_sobel=args.lambda_sobel,
-                sobel_warmup_epochs=args.sobel_warmup_epochs)
+                sobel_warmup_epochs=args.sobel_warmup_epochs,
+                lambda_angular=args.lambda_angular)
     optim = over(optim, lr=args.lr, lr_policy=args.lr_policy,
                  lr_decay_iters=args.lr_decay_iters, beta1=args.beta1,
                  niter=args.niter, niter_decay=args.niter_decay,

@@ -105,6 +105,10 @@ class LossConfig:
     # effective sobel weight ramps linearly to lambda_sobel over this
     # many epochs (``100/20*epoch`` shape); 0 = constant weight.
     sobel_warmup_epochs: int = 0
+    # Mean angular error (degrees) between fake and real per-pixel color
+    # vectors — the reference's commented-out experiment
+    # (train.py:355-360; angular_loss at networks.py:870). 0 = off.
+    lambda_angular: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -46,8 +46,11 @@ def angular_loss(illum_gt: jax.Array, illum_pred: jax.Array) -> jax.Array:
     a = illum_gt.astype(jnp.float32)
     b = illum_pred.astype(jnp.float32)
     dot = jnp.sum(a * b, axis=-1)
-    na = jnp.linalg.norm(a, axis=-1)
-    nb = jnp.linalg.norm(b, axis=-1)
+    # eps under the sqrt, not just in the quotient: d‖v‖/dv is 0/0 = NaN
+    # at v = 0 (an exactly-mid-gray pixel), and this loss is live behind
+    # lambda_angular
+    na = jnp.sqrt(jnp.sum(a * a, axis=-1) + 1e-12)
+    nb = jnp.sqrt(jnp.sum(b * b, axis=-1) + 1e-12)
     cos = dot / jnp.maximum(na * nb, 1e-8)
     cos = jnp.clip(cos, -0.99999, 0.99999)
     return jnp.mean(jnp.arccos(cos)) * 180.0 / jnp.pi

@@ -238,6 +238,12 @@ def build_train_step(
                 l_tv = total_variation_loss(fake_b) * L.lambda_tv
                 parts["g_tv"] = l_tv
                 total = total + l_tv
+            if L.lambda_angular > 0:
+                from p2p_tpu.ops.sobel import angular_loss
+
+                l_ang = angular_loss(real_b, fake_b) * L.lambda_angular
+                parts["g_angular"] = l_ang
+                total = total + l_ang
             if L.lambda_sobel > 0:
                 from p2p_tpu.ops.sobel import sobel_edges
 

@@ -432,3 +432,12 @@ def test_sharded_pallas_instance_norm_no_activation_allgather(devices8):
             dims = [int(d) for d in m.group(1).split(",") if d]
             numel = int(np.prod(dims)) if dims else 0
             assert numel < full, f"activation-sized all-gather in HLO: {ln}"
+
+
+def test_angular_loss_gradient_finite_on_zero_vectors():
+    """d||v||/dv is 0/0 at v=0 (exactly-mid-gray pixels) — live behind
+    lambda_angular, so the eps-under-sqrt guard matters."""
+    a = jnp.zeros((1, 4, 4, 3))
+    b = jnp.ones((1, 4, 4, 3)) * 0.5
+    g = jax.grad(lambda x: angular_loss(b, x))(a)
+    assert bool(jnp.isfinite(g).all())
