@@ -34,19 +34,23 @@ from p2p_tpu.ops.conv import upsample_nearest
 
 class ResidualBlock(nn.Module):
     """conv-norm-relu-conv-norm + identity, relu after add.
-    Ref: networks.py:429-444."""
+    Ref: networks.py:429-444. ``int8``: both k3-s1 convs on the int8
+    MXU path (ops/int8.py)."""
 
     features: int
     norm: str = "batch"
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
-        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(x)
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+                      dtype=self.dtype)(x)
         y = mk()(y)
         y = relu_y(y)
-        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+                      dtype=self.dtype)(y)
         y = mk()(y)
         return relu_y(y + x)
 
@@ -57,6 +61,9 @@ class ExpandNetwork(nn.Module):
     out_channels: int = 3
     norm: str = "batch"
     remat: Union[bool, str] = False
+    # int8 MXU path for the residual trunk's k3-s1 convs (stem/updown/
+    # head stay bf16)
+    int8: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -75,7 +82,8 @@ class ExpandNetwork(nn.Module):
         residual = y
         for i in range(self.n_blocks):
             # explicit name: remat wrapping must not change param paths
-            y = block_cls(self.ngf * 4, norm=self.norm, dtype=self.dtype,
+            y = block_cls(self.ngf * 4, norm=self.norm, int8=self.int8,
+                          dtype=self.dtype,
                           name=f"ResidualBlock_{i}")(y, train)
         y = leaky_relu_y(y + residual, 0.2)
 

@@ -27,6 +27,7 @@ def define_C(cfg: ModelConfig, dtype=None) -> nn.Module:
 
 
 def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
+    int8_g = cfg.int8 and cfg.int8_generator
     if cfg.generator == "expand":
         return ExpandNetwork(
             ngf=cfg.ngf,
@@ -34,6 +35,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             out_channels=cfg.output_nc,
             norm=cfg.norm,
             remat=remat,
+            int8=int8_g,
             dtype=dtype,
         )
     if cfg.generator == "unet":
@@ -42,12 +44,10 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
         return UNetGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc, norm=cfg.norm,
             use_dropout=cfg.use_dropout, upsample_mode=cfg.upsample_mode,
-            int8=(cfg.int8 and cfg.int8_generator
-                  and cfg.upsample_mode == "deconv"),
+            int8=int8_g and cfg.upsample_mode == "deconv",
             int8_decoder=cfg.int8_decoder,
             dtype=dtype,
         )
-    int8_g = cfg.int8 and cfg.int8_generator
     if cfg.generator == "resnet":
         from p2p_tpu.models.resnet_gen import ResnetGenerator
 

@@ -178,3 +178,32 @@ def test_resnet_block_int8_param_compat_and_close():
     yq = q.apply(v, x)
     rel = (jnp.linalg.norm(yq - yr) / jnp.linalg.norm(yr)).item()
     assert rel < 0.03, rel
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["expand", "unet", "resnet"])
+def test_int8_generator_families_train_one_step(family):
+    """Every generator family accepts int8+int8_generator and takes one
+    finite training step (the registry threading regression gate)."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = get_preset("reference" if family == "expand" else "facades")
+    cfg = cfg.replace(
+        model=dataclasses.replace(
+            cfg.model, generator=family, int8=True, int8_generator=True,
+            ngf=8, n_blocks=2, ndf=8, num_D=2, use_dropout=False,
+            norm="instance" if family == "resnet" else cfg.model.norm),
+        loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=32),
+    )
+    b = {k: jnp.asarray(v, jnp.float32)
+         for k, v in synthetic_batch(2, 32, bits=cfg.model.quant_bits).items()}
+    state = create_train_state(cfg, jax.random.key(0), b)
+    step = build_train_step(cfg, None)
+    state, m = step(state, b)
+    assert np.isfinite(float(m["loss_g"])) and np.isfinite(float(m["loss_d"]))
