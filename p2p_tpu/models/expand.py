@@ -13,9 +13,11 @@ Residual blocks use BatchNorm (not InstanceNorm) exactly like the reference
 (networks.py:433) — a ``norm`` knob swaps in InstanceNorm / Pallas
 InstanceNorm for the HD configs.
 
-TPU-first: the residual trunk is where the FLOPs live — it stays in bf16 on
-the MXU and is optionally rematerialized (``remat``) to trade FLOPs for HBM
-when spatial extents are large.
+TPU-first: the residual trunk is where the FLOPs live — it runs on the MXU
+in bf16, or on the s8×s8→s32 int8 path when ``int8`` is set
+(ops/int8.py; the k3-s1 trunk is the form where all three quantized
+contractions win), and is optionally rematerialized (``remat``) to trade
+FLOPs for HBM when spatial extents are large.
 """
 
 from __future__ import annotations
