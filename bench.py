@@ -77,6 +77,16 @@ def main() -> None:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8=True, int8_generator=both))
         preset = preset + ("_i8gd" if both else "_i8d")
+    if os.environ.get("BENCH_DELAYED", "") == "1":
+        # delayed (stored-scale) activation quantization, ops/int8.py
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, int8_delayed=True))
+        preset = preset + "_ds"
+    if os.environ.get("BENCH_I8DEC", "") == "1":
+        # quantized subpixel decoder for the U-Net (QuantSubpixelDeconv)
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, int8=True, int8_generator=True, int8_decoder=True))
+        preset = preset + "_i8dec"
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
     host = synthetic_batch(batch_size=bs, size=img, bits=cfg.model.quant_bits,

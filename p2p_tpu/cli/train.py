@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--int8_generator", action="store_true", default=None,
                    help="extend --int8 to the generator convs (measured "
                         "slower on v5e at 256^2; see ModelConfig)")
+    p.add_argument("--int8_delayed", action="store_true", default=None,
+                   help="delayed (stored-scale) activation quantization: "
+                        "per-layer amax carried in TrainState; removes "
+                        "the absmax reductions from the critical path "
+                        "(ops/int8.py int8_conv_ds)")
     # --- reference flags (train.py:133-157), same names/defaults ---------
     p.add_argument("--dataset", type=str, default=None, help="facades")
     p.add_argument("--name", type=str, default=None, help="training name")
@@ -104,6 +109,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "ImagePool(0) = passthrough); >0 enables a "
                         "device-side ring buffer. Image presets only — "
                         "the video step has no pool")
+    p.add_argument("--save_masks", action="store_true", default=None,
+                   help="dump mask.png = bitwise_and(uint8(fake_b), "
+                        "uint8(real_a)) with the eval samples (the "
+                        "reference's commented masking experiment, "
+                        "train.py:324-334; visualization only)")
     p.add_argument("--eval_fid", action="store_true", default=None,
                    help="compute FID (VFID for video presets) per eval epoch "
                         "from VGG19 features; the feature source "
@@ -134,7 +144,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     model = over(model, input_nc=args.input_nc, output_nc=args.output_nc,
                  ngf=args.ngf, ndf=args.ndf, n_blocks=args.n_blocks,
                  upsample_mode=args.upsample_mode, int8=args.int8,
-                 int8_generator=args.int8_generator)
+                 int8_generator=args.int8_generator,
+                 int8_delayed=args.int8_delayed)
     loss = over(loss, lambda_l1=args.lamb, lambda_vgg=args.lambda_vgg,
                 lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv,
                 lambda_sobel=args.lambda_sobel,
@@ -157,7 +168,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     train = over(train, nepoch=args.nepoch, epoch_count=args.epoch_count,
                  epoch_save=args.epochsave, seed=args.seed,
                  eval_fid=args.eval_fid, scan_steps=args.scan_steps,
-                 pool_size=args.pool_size)
+                 pool_size=args.pool_size, save_masks=args.save_masks)
     if args.mesh is not None:
         from p2p_tpu.core.mesh import MeshSpec
 

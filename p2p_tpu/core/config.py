@@ -81,6 +81,14 @@ class ModelConfig:
     # on v5e (interleave + large-spatial wgrad slices); kept reachable
     # for other chips/shapes.
     int8_decoder: bool = False
+    # Delayed (stored-scale) activation quantization: per-layer amax
+    # carried in a 'quant' collection threaded through TrainState (like
+    # batch_stats), so the forward quantize no longer serializes on an
+    # absmax reduction — one HBM pass instead of two per quantized
+    # activation, and the dominant cost at bs=1 (ops/int8.py
+    # int8_conv_ds). Transient clipping after an activation spike decays
+    # in one step (decaying-max update).
+    int8_delayed: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,6 +192,11 @@ class TrainConfig:
     pool_size: int = 0
     # jax_debug_nans: first NaN-producing primitive raises with location.
     debug_nans: bool = False
+    # The reference's commented "masking" experiment (train.py:324-334):
+    # dump mask.png = bitwise_and(uint8(fake_b), uint8(real_a)) next to
+    # the eval sample images. Pure visualization — it feeds no loss in
+    # the reference either.
+    save_masks: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -42,16 +42,17 @@ class ResidualBlock(nn.Module):
     features: int
     norm: str = "batch"
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
-        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       dtype=self.dtype)(x)
         y = mk()(y)
         y = relu_y(y)
-        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       dtype=self.dtype)(y)
         y = mk()(y)
         return relu_y(y + x)
@@ -66,6 +67,7 @@ class ExpandNetwork(nn.Module):
     # int8 MXU path for the residual trunk's k3-s1 convs (stem/updown/
     # head stay bf16)
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -84,7 +86,7 @@ class ExpandNetwork(nn.Module):
         residual = y
         for i in range(self.n_blocks):
             # explicit name: remat wrapping must not change param paths
-            y = block_cls(self.ngf * 4, norm=self.norm, int8=self.int8,
+            y = block_cls(self.ngf * 4, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
                           dtype=self.dtype,
                           name=f"ResidualBlock_{i}")(y, train)
         y = leaky_relu_y(y + residual, 0.2)

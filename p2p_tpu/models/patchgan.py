@@ -54,6 +54,7 @@ class _PlainConv(nn.Module):
     # int8 QAT MXU path (ops/int8.py) — set by NLayerDiscriminator on
     # its wide inner convs only.
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -65,6 +66,7 @@ class _PlainConv(nn.Module):
                 self.features, kernel_size=4, strides=self.stride,
                 padding=self.padding, dtype=self.dtype,
                 kernel_init=normal_init(), name="Conv_0",
+                delayed=self.int8_delayed,
             )(x)
         return save_conv_out(nn.Conv(
             self.features,
@@ -87,6 +89,7 @@ class NLayerDiscriminator(nn.Module):
     # norm: the power iteration tracks the true f32 weight and only the
     # normalized w/σ is quantized (SpectralConv.int8).
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -101,10 +104,12 @@ class NLayerDiscriminator(nn.Module):
             if self.use_spectral_norm:
                 y = SpectralConv(
                     features, kernel_size=4, stride=stride, padding=2,
-                    int8=self.int8, dtype=self.dtype
+                    int8=self.int8, int8_delayed=self.int8_delayed,
+                    dtype=self.dtype
                 )(y)
             else:
                 y = _PlainConv(features, stride=stride, int8=self.int8,
+                               int8_delayed=self.int8_delayed,
                                dtype=self.dtype)(y)
             return leaky_relu_y(y, 0.2)
 
@@ -135,6 +140,7 @@ class MultiscaleDiscriminator(nn.Module):
     use_sigmoid: bool = False
     get_interm_feat: bool = True
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -151,6 +157,7 @@ class MultiscaleDiscriminator(nn.Module):
                 use_sigmoid=self.use_sigmoid,
                 get_interm_feat=self.get_interm_feat,
                 int8=self.int8,
+                int8_delayed=self.int8_delayed,
                 dtype=self.dtype,
                 name=f"scale{self.num_D - 1 - i}",
             )

@@ -44,6 +44,7 @@ def GlobalGenerator(
     return_features: bool = False,
     remat: Union[bool, str] = False,
     int8: bool = False,
+    int8_delayed: bool = False,
     dtype=None,
     name: Optional[str] = None,
 ) -> ResnetGenerator:
@@ -53,7 +54,7 @@ def GlobalGenerator(
         ngf=ngf, n_blocks=n_blocks, out_channels=out_channels,
         n_downsampling=4, norm=norm, max_features=1024,
         return_features=return_features, remat=remat, int8=int8,
-        dtype=dtype, name=name,
+        int8_delayed=int8_delayed, dtype=dtype, name=name,
     )
 
 
@@ -68,6 +69,7 @@ class Pix2PixHDGenerator(nn.Module):
     remat: Union[bool, str] = False
     # int8 MXU path for the G1 trunk + local enhancer ResnetBlocks
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -79,7 +81,7 @@ class Pix2PixHDGenerator(nn.Module):
         x_half = avg_pool_downsample(x)
         g1_feats = GlobalGenerator(
             ngf=self.ngf, n_blocks=self.n_blocks_global, norm=self.norm,
-            return_features=True, remat=self.remat, int8=self.int8,
+            return_features=True, remat=self.remat, int8=self.int8, int8_delayed=self.int8_delayed,
             dtype=self.dtype, name="global",
         )(x_half, train)
 
@@ -94,7 +96,7 @@ class Pix2PixHDGenerator(nn.Module):
         block_cls = remat_wrap(ResnetBlock, self.remat)
         for i in range(self.n_blocks_local):
             # explicit name: remat wrapping must not change param paths
-            y = block_cls(self.ngf, norm=self.norm, int8=self.int8,
+            y = block_cls(self.ngf, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
                           dtype=self.dtype,
                           name=f"ResnetBlock_{i}")(y, train)
 

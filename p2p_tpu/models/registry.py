@@ -28,6 +28,7 @@ def define_C(cfg: ModelConfig, dtype=None) -> nn.Module:
 
 def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
     int8_g = cfg.int8 and cfg.int8_generator
+    delayed = cfg.int8_delayed
     if cfg.generator == "expand":
         return ExpandNetwork(
             ngf=cfg.ngf,
@@ -36,6 +37,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             norm=cfg.norm,
             remat=remat,
             int8=int8_g,
+            int8_delayed=delayed,
             dtype=dtype,
         )
     if cfg.generator == "unet":
@@ -46,6 +48,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             use_dropout=cfg.use_dropout, upsample_mode=cfg.upsample_mode,
             int8=int8_g and cfg.upsample_mode == "deconv",
             int8_decoder=cfg.int8_decoder,
+            int8_delayed=delayed,
             dtype=dtype,
         )
     if cfg.generator == "resnet":
@@ -58,6 +61,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             norm=cfg.norm,
             remat=remat,
             int8=int8_g,
+            int8_delayed=delayed,
             dtype=dtype,
         )
     if cfg.generator == "pix2pixhd":
@@ -66,7 +70,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
         return Pix2PixHDGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc,
             n_blocks_global=cfg.n_blocks, norm=cfg.norm,
-            remat=remat, int8=int8_g, dtype=dtype,
+            remat=remat, int8=int8_g, int8_delayed=delayed, dtype=dtype,
         )
     if cfg.generator == "pix2pixhd_global":
         # phase 1 of the coarse-to-fine schedule: G1 alone at half res
@@ -74,7 +78,8 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
 
         return GlobalGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc, n_blocks=cfg.n_blocks,
-            norm=cfg.norm, remat=remat, int8=int8_g, dtype=dtype,
+            norm=cfg.norm, remat=remat, int8=int8_g, int8_delayed=delayed,
+            dtype=dtype,
         )
     raise ValueError(f"unknown generator {cfg.generator!r}")
 
@@ -87,6 +92,7 @@ def define_D(cfg: ModelConfig, dtype=None) -> nn.Module:
         use_spectral_norm=cfg.use_spectral_norm,
         get_interm_feat=cfg.get_interm_feat,
         int8=cfg.int8,
+        int8_delayed=cfg.int8_delayed,
         dtype=dtype,
     )
 

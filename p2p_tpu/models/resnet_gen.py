@@ -36,15 +36,16 @@ class ResnetBlock(nn.Module):
     features: int
     norm: str = "instance"
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
-        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       dtype=self.dtype)(x)
         y = relu_y(mk()(y))
-        y = ConvLayer(self.features, kernel_size=3, int8=self.int8,
+        y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       dtype=self.dtype)(y)
         y = mk()(y)
         return x + y
@@ -67,6 +68,7 @@ class ResnetGenerator(nn.Module):
     # stride-2 downs, upsample convs and head stay bf16 — HBM-bound or
     # quality-critical).
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -87,7 +89,7 @@ class ResnetGenerator(nn.Module):
             # explicit name: remat wrapping must not change param paths
             # (nn.remat's auto-name is 'CheckpointResnetBlock_i', which
             # would silently re-key checkpoints when remat is toggled)
-            y = block_cls(f_trunk, norm=self.norm, int8=self.int8,
+            y = block_cls(f_trunk, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
                           dtype=self.dtype,
                           name=f"ResnetBlock_{i}")(y, train)
 

@@ -55,6 +55,7 @@ class UNetGenerator(nn.Module):
     # Requires upsample_mode == "deconv".
     int8: bool = False
     int8_decoder: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -80,6 +81,7 @@ class UNetGenerator(nn.Module):
                 return QuantConv(
                     features, kernel_size=4, strides=2, padding=1,
                     dtype=self.dtype, kernel_init=normal_init(), name=name,
+                    delayed=self.int8_delayed,
                 )(y)
             return save_conv_out(nn.Conv(
                 features, kernel_size=(4, 4), strides=(2, 2), padding=1,
@@ -119,7 +121,7 @@ class UNetGenerator(nn.Module):
                     from p2p_tpu.ops.int8 import QuantSubpixelDeconv
 
                     y = QuantSubpixelDeconv(
-                        f, dtype=self.dtype,
+                        f, dtype=self.dtype, delayed=self.int8_delayed,
                         kernel_init=normal_init(), name=f"up{i}",
                     )(y)
                 else:

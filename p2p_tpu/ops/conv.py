@@ -88,6 +88,7 @@ class ConvLayer(nn.Module):
     stride: int = 1
     use_bias: bool = True
     int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
 
@@ -102,7 +103,7 @@ class ConvLayer(nn.Module):
                 self.features, kernel_size=self.kernel_size,
                 strides=self.stride, padding=0, use_bias=self.use_bias,
                 dtype=self.dtype, kernel_init=self.kernel_init,
-                name="Conv_0",
+                name="Conv_0", delayed=self.int8_delayed,
             )(x)
         return save_conv_out(nn.Conv(
             features=self.features,

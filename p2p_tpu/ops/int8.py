@@ -39,6 +39,7 @@ path).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable, Optional, Sequence, Tuple
 
@@ -52,6 +53,22 @@ from p2p_tpu.ops.conv import normal_init, save_conv_out, subpixel_interleave
 Pads = Tuple[Tuple[int, int], Tuple[int, int]]
 
 _DN = ("NHWC", "HWIO", "NHWC")
+
+# Dispatch bounds for the unrolled int8 wgrad (see _int8_bwd_core):
+# output spatial sizes in [MIN, MAX] use the k²-unrolled int8
+# dot_general form; the rest fall back to the bf16 CHWN conv.
+# - MIN = 256: below ~16² output positions the int8 strided slices
+#   kernel-fault the CURRENT v5e TPU runtime (reproduced on 4×4 inputs;
+#   tests/test_int8.py carries a skippable on-TPU repro) — this bound is
+#   runtime-version-scoped, not physics: if a runtime upgrade fixes the
+#   fault, set P2P_INT8_WGRAD_SLICE_MIN=0 and re-run the repro test.
+# - MAX = 4096 (64²): above it the k² slices of the padded input
+#   materialize more HBM traffic than the int8 MXU rate buys back (the
+#   round-2 "decoder int8 loses" finding).
+_INT8_WGRAD_SLICE_MIN = int(
+    os.environ.get("P2P_INT8_WGRAD_SLICE_MIN", "256"))
+_INT8_WGRAD_SLICE_MAX = int(
+    os.environ.get("P2P_INT8_WGRAD_SLICE_MAX", "4096"))
 
 
 def absmax_scale(x: jax.Array, axis=None) -> jax.Array:
@@ -128,7 +145,7 @@ def _int8_conv_fwd(x, w, strides, padding, lhs_dilation):
     return y.astype(x.dtype), (xq, sx, wq, sw, x_tok, w_tok)
 
 
-def _int8_conv_bwd(strides, padding, lhs_dilation, res, g):
+def _int8_bwd_core(strides, padding, lhs_dilation, res, g):
     """Mixed-form backward. Each contraction runs in whichever of int8 /
     bf16 measured faster on v5e for its structural form (chained
     microbenchmarks, see module docstring table):
@@ -188,8 +205,12 @@ def _int8_conv_bwd(strides, padding, lhs_dilation, res, g):
     # int8 slices + dot_general kernel-fault the v5e runtime below ~16²
     # output positions (reproduced: stride-2 slices at 4×4 input crash
     # the TPU worker; the identical pattern at 64²+ is fine) — and the
-    # MXU gain is negligible there anyway. Static spatial guard.
-    if plain and ho * wo >= 256:
+    # MXU gain is negligible there anyway. Static spatial guard, with an
+    # UPPER bound too: above ~64² output positions the k² strided slices
+    # of the (already large) padded input materialize more HBM traffic
+    # than the int8 MXU rate buys back (the round-2 "decoder int8 loses"
+    # finding) — those big-spatial wgrads take the bf16 CHWN conv below.
+    if plain and _INT8_WGRAD_SLICE_MIN <= ho * wo <= _INT8_WGRAD_SLICE_MAX:
         sg = absmax_scale(gf)
         gq = quantize_int8(gf, sg)
         (plo_h, phi_h), (plo_w, phi_w) = padding
@@ -230,11 +251,91 @@ def _int8_conv_bwd(strides, padding, lhs_dilation, res, g):
     return dx, dw
 
 
+def _int8_conv_bwd(strides, padding, lhs_dilation, res, g):
+    return _int8_bwd_core(strides, padding, lhs_dilation, res, g)
+
+
 int8_conv.defvjp(_int8_conv_fwd, _int8_conv_bwd)
+
+
+# ---------------------------------------------------------------- delayed
+# Delayed (stored-scale) activation quantization — TransformerEngine-style
+# amax bookkeeping adapted to convs. The dynamic path above serializes on
+# a full absmax reduction over x before the quantize can start (two HBM
+# passes over every quantized activation, and a latency chain XLA cannot
+# hide). Here the scale comes from the PREVIOUS step (a "quant" flax
+# collection threaded through TrainState like batch_stats), so the
+# quantize fuses into the producer, and the current amax is measured in
+# the SAME pass to update the stored value for the next step. Transient
+# under-scaling clips symmetrically at ±127 for one step — the decaying-
+# max update (module code) adapts the scale upward immediately after.
+# Cotangent (backward) scales stay dynamic: custom_vjp backward passes
+# cannot write state, and the cotangent absmax fuses with the g·s_w fold
+# anyway.
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def int8_conv_ds(x: jax.Array, w: jax.Array, sx: jax.Array,
+                 strides: Tuple[int, int], padding: Pads,
+                 lhs_dilation: Tuple[int, int] = (1, 1)):
+    """``int8_conv`` with a STORED per-tensor activation scale ``sx``.
+
+    Returns ``(y, amax_x)`` — the conv output and the CURRENT max|x|
+    measured in the quantize pass, for the caller's scale update.
+    """
+    out, _ = _int8_conv_ds_fwd(x, w, sx, strides, padding, lhs_dilation)
+    return out
+
+
+def _int8_conv_ds_fwd(x, w, sx, strides, padding, lhs_dilation):
+    sx = jnp.maximum(sx.astype(jnp.float32), 1e-12)
+    sw = absmax_scale(w, axis=(0, 1, 2))          # (1,1,1,O) — w is tiny
+    xf = x.astype(jnp.float32)
+    xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    amax = jnp.max(jnp.abs(xf))                   # fused into the same pass
+    wq = quantize_int8(w, sw)
+    y32 = _conv_i32(xq, wq, strides, padding, lhs_dil=lhs_dilation)
+    y = y32.astype(jnp.float32) * (sx * sw.reshape(1, 1, 1, -1))
+    x_tok = jnp.zeros((0,), x.dtype)
+    w_tok = jnp.zeros((0,), w.dtype)
+    return (y.astype(x.dtype), amax), (xq, sx, wq, sw, x_tok, w_tok)
+
+
+def _int8_conv_ds_bwd(strides, padding, lhs_dilation, res, ct):
+    g, _ = ct  # the amax output feeds a state update, never a loss
+    dx, dw = _int8_bwd_core(strides, padding, lhs_dilation, res, g)
+    return dx, dw, jnp.zeros((), jnp.float32)
+
+
+int8_conv_ds.defvjp(_int8_conv_ds_fwd, _int8_conv_ds_bwd)
+
+
+# Decaying-max amax update: responds upward immediately (next step uses
+# the larger measured amax), decays 5%/step when activations shrink so a
+# one-off spike doesn't pin the scale forever.
+AMAX_DECAY = 0.95
 
 
 def _norm_pair(v) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _delayed_scale(mod: nn.Module, x: jax.Array):
+    """Stored-scale plumbing shared by the Quant* modules: an ``amax_x``
+    scalar in the 'quant' collection (initialized from the init batch),
+    read as this step's scale. Returns ``(sx, update_fn)``; call
+    ``update_fn(cur_amax)`` with the amax the conv measured."""
+    amax_v = mod.variable(
+        "quant", "amax_x",
+        lambda: jnp.max(jnp.abs(x.astype(jnp.float32))),
+    )
+    sx = jnp.maximum(amax_v.value, 1e-12) / 127.0
+
+    def update(cur_amax):
+        if mod.is_mutable_collection("quant"):
+            amax_v.value = jnp.maximum(cur_amax, AMAX_DECAY * amax_v.value)
+
+    return sx, update
 
 
 class QuantConv(nn.Module):
@@ -242,7 +343,9 @@ class QuantConv(nn.Module):
 
     Parameter tree ("kernel" HWIO + optional "bias") matches ``nn.Conv``
     so bf16↔int8 checkpoints interchange. ``padding`` is an int (both
-    sides) or explicit ((lo,hi),(lo,hi)).
+    sides) or explicit ((lo,hi),(lo,hi)). ``delayed`` switches the
+    activation scale to the stored-amax path (see int8_conv_ds): the
+    'quant' collection must then be threaded by the caller.
     """
 
     features: int
@@ -252,6 +355,7 @@ class QuantConv(nn.Module):
     use_bias: bool = True
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
+    delayed: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -263,8 +367,14 @@ class QuantConv(nn.Module):
         pad = self.padding
         pad = ((pad, pad), (pad, pad)) if isinstance(pad, int) else pad
         dt = self.dtype or jnp.float32
-        y = int8_conv(x.astype(dt), kernel.astype(dt),
-                      _norm_pair(self.strides), pad)
+        if self.delayed:
+            sx, update = _delayed_scale(self, x)
+            y, amax = int8_conv_ds(x.astype(dt), kernel.astype(dt), sx,
+                                   _norm_pair(self.strides), pad)
+            update(amax)
+        else:
+            y = int8_conv(x.astype(dt), kernel.astype(dt),
+                          _norm_pair(self.strides), pad)
         y = save_conv_out(y)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,
@@ -289,6 +399,7 @@ class QuantSubpixelDeconv(nn.Module):
     use_bias: bool = True
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
+    delayed: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -296,6 +407,7 @@ class QuantSubpixelDeconv(nn.Module):
             4 * self.features, kernel_size=2, strides=1,
             padding=((1, 1), (1, 1)), use_bias=self.use_bias,
             dtype=self.dtype, kernel_init=self.kernel_init, name="Conv_0",
+            delayed=self.delayed,
         )(x)                                    # (N, H+1, W+1, 4F)
         return subpixel_interleave(out, self.features)
 
@@ -315,6 +427,7 @@ class QuantConvTranspose(nn.Module):
     use_bias: bool = True
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
+    delayed: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -332,8 +445,14 @@ class QuantConvTranspose(nn.Module):
             lo = ki - 1 if si > ki - 1 else int(np.ceil(total / 2))
             pads.append((lo, total - lo))
         dt = self.dtype or jnp.float32
-        y = int8_conv(x.astype(dt), kernel.astype(dt), (1, 1),
-                      tuple(pads), lhs_dilation=s)
+        if self.delayed:
+            sx, update = _delayed_scale(self, x)
+            y, amax = int8_conv_ds(x.astype(dt), kernel.astype(dt), sx,
+                                   (1, 1), tuple(pads), lhs_dilation=s)
+            update(amax)
+        else:
+            y = int8_conv(x.astype(dt), kernel.astype(dt), (1, 1),
+                          tuple(pads), lhs_dilation=s)
         y = save_conv_out(y)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,

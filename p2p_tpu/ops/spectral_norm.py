@@ -73,6 +73,9 @@ class SpectralConv(nn.Module):
     # "quantize the derived weight" order torch QAT uses for weight-norm
     # wrappers.
     int8: bool = False
+    # stored-scale activation quantization (ops/int8.py int8_conv_ds);
+    # requires the caller to thread the 'quant' collection.
+    int8_delayed: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -99,13 +102,23 @@ class SpectralConv(nn.Module):
 
         pad = self.padding
         if self.int8:
-            from p2p_tpu.ops.int8 import int8_conv
-
             p = ((pad, pad), (pad, pad))
-            y = int8_conv(
-                x.astype(kernel_sn.dtype), kernel_sn,
-                (self.stride, self.stride), p,
-            )
+            if self.int8_delayed:
+                from p2p_tpu.ops.int8 import _delayed_scale, int8_conv_ds
+
+                sx, update = _delayed_scale(self, x)
+                y, amax = int8_conv_ds(
+                    x.astype(kernel_sn.dtype), kernel_sn, sx,
+                    (self.stride, self.stride), p,
+                )
+                update(amax)
+            else:
+                from p2p_tpu.ops.int8 import int8_conv
+
+                y = int8_conv(
+                    x.astype(kernel_sn.dtype), kernel_sn,
+                    (self.stride, self.stride), p,
+                )
         else:
             if pad:
                 x = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))

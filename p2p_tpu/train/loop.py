@@ -374,6 +374,17 @@ class Trainer:
                          os.path.join(out_dir, f"e{self.epoch}_target.png"))
                 save_img(np.asarray(pred)[0].astype(np.float32),
                          os.path.join(out_dir, f"e{self.epoch}_pred.png"))
+                if cfg.train.save_masks:
+                    # the reference's commented masking experiment
+                    # (train.py:329-334): bitwise-AND of the uint8 images
+                    from p2p_tpu.utils.images import to_uint8_img
+
+                    mask = np.bitwise_and(
+                        to_uint8_img(np.asarray(pred)[0].astype(np.float32)),
+                        to_uint8_img(np.asarray(batch["input"])[0]),
+                    )
+                    save_img(mask, os.path.join(
+                        out_dir, f"e{self.epoch}_mask.png"))
                 sample_saved = True
         result = {
             "psnr_mean": float(np.mean(psnrs)),

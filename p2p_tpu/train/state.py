@@ -41,6 +41,12 @@ class TrainState(struct.PyTreeNode):
     # None keeps the pytree structure unchanged when disabled
     pool: Optional[jax.Array] = None
     pool_n: Optional[jax.Array] = None
+    # delayed int8 activation scales ('quant' collections, ops/int8.py).
+    # None when int8_delayed is off — None flattens to an empty subtree,
+    # so pre-round-3 checkpoints keep restoring bit-for-bit.
+    quant_g: Any = None
+    quant_d: Any = None
+    quant_c: Any = None
 
 
 def _zero_nonfinite() -> optax.GradientTransformation:
@@ -58,6 +64,20 @@ def _zero_nonfinite() -> optax.GradientTransformation:
 
     return optax.GradientTransformation(
         lambda params: optax.EmptyState(), update
+    )
+
+
+def count_nonfinite(tree: Any) -> jax.Array:
+    """Total number of non-finite (inf/NaN) entries across a gradient
+    pytree — the observability hook for ``_zero_nonfinite``: the guard
+    silently drops bad entries, so the step surfaces this count in its
+    metrics (``nonfinite_g``/``nonfinite_d``) whenever ``grad_clip > 0``;
+    a sustained non-zero value is a diverging loss the guard is masking."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    return sum(
+        jnp.sum(~jnp.isfinite(g)).astype(jnp.int32) for g in leaves
     )
 
 
@@ -144,6 +164,7 @@ def create_train_state(
         )
         pool_n = jnp.zeros((), jnp.int32)
 
+    delayed = cfg.model.int8_delayed
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         lr_scale=jnp.ones((), jnp.float32),
@@ -158,4 +179,7 @@ def create_train_state(
         opt_c=opt_c_state,
         pool=pool,
         pool_n=pool_n,
+        quant_g=vg.get("quant", {}) if delayed else None,
+        quant_d=vd.get("quant", {}) if delayed else None,
+        quant_c=None,
     )

@@ -75,25 +75,27 @@ def _concat_pair(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.concatenate([a, b], axis=-1)
 
 
-def single_forward_d_losses(d_apply, spectral0, params_d, fake_pair,
+def single_forward_d_losses(d_apply, dvars0, params_d, fake_pair,
                             real_pair, gan_mode: str):
     """ONE D(fake) forward whose vjp serves both the D loss and (later) the
     G loss — the "single-forward structure" of the module docstring, shared
     by the image step (spatial D) and the video step (spatial + temporal D).
 
-    ``d_apply(params, spectral, x) -> (preds, mutated_vars)`` is the
-    discriminator apply fn. Returns ``(loss_d, grads_d, pred_fake,
-    pred_real, spectral2, pull)`` where ``pull(ct_pred) -> cotangent wrt
-    fake_pair`` re-uses the fake forward's residuals (its params cotangent
-    is dead code XLA removes — the reference's zero_grad before the D
-    step), and ``spectral2`` is the u/v state after the fake→real forward
-    chain (2 power iterations per step; deviation documented above).
+    ``d_apply(params, dvars, x) -> (preds, new_dvars)`` is the
+    discriminator apply fn; ``dvars`` is the dict of threaded non-param
+    collections (``{'spectral': ...}``, plus ``'quant'`` when delayed int8
+    scaling is on). Returns ``(loss_d, grads_d, pred_fake, pred_real,
+    dvars2, pull)`` where ``pull(ct_pred) -> cotangent wrt fake_pair``
+    re-uses the fake forward's residuals (its params cotangent is dead
+    code XLA removes — the reference's zero_grad before the D step), and
+    ``dvars2`` is the collection state after the fake→real forward chain
+    (2 spectral power iterations per step; deviation documented above).
     """
     def fake_primal(params, pair):
-        pred, s1 = d_apply(params, spectral0, pair)
-        return pred, s1["spectral"]
+        pred, v1 = d_apply(params, dvars0, pair)
+        return pred, v1
 
-    pred_fake, d_vjp, spectral_s1 = jax.vjp(
+    pred_fake, d_vjp, dvars1 = jax.vjp(
         fake_primal, params_d, fake_pair, has_aux=True
     )
     loss_fake, ct_fake = jax.value_and_grad(
@@ -102,17 +104,17 @@ def single_forward_d_losses(d_apply, spectral0, params_d, fake_pair,
     gd_fake = d_vjp(ct_fake)[0]  # pair cotangent dead → DCE
 
     def real_fn(params):
-        pred_real, s2 = d_apply(params, spectral_s1, real_pair)
+        pred_real, v2 = d_apply(params, dvars1, real_pair)
         loss = 0.5 * gan_loss(pred_real, True, gan_mode)
-        return loss, (s2["spectral"], pred_real)
+        return loss, (v2, pred_real)
 
-    (loss_real, (spectral2, pred_real)), gd_real = jax.value_and_grad(
+    (loss_real, (dvars2, pred_real)), gd_real = jax.value_and_grad(
         real_fn, has_aux=True
     )(params_d)
     loss_d = loss_fake + loss_real
     grads_d = jax.tree_util.tree_map(jnp.add, gd_fake, gd_real)
     pred_real = jax.tree_util.tree_map(jax.lax.stop_gradient, pred_real)
-    return loss_d, grads_d, pred_fake, pred_real, spectral2, (
+    return loss_d, grads_d, pred_fake, pred_real, dvars2, (
         lambda ct: d_vjp(ct)[1]
     )
 
@@ -143,17 +145,27 @@ def build_train_step(
     # in the models for the big-activation presets, where remat is useful
     # anyway. (The duplicated D(fake) subgraph that note originally
     # discussed is now structurally gone — see the module docstring.)
-    def g_fwd(params, bstats, x, rng=None):
-        rngs = {"dropout": rng} if (use_dropout and rng is not None) else None
-        return g.apply(
-            {"params": params, "batch_stats": bstats}, x, True,
-            mutable=["batch_stats"], rngs=rngs,
-        )
+    # delayed int8 scaling threads a 'quant' collection (stored activation
+    # amax, ops/int8.py) through G and D exactly like batch_stats/spectral
+    use_quant = cfg.model.int8_delayed
+    d_colls = ("spectral", "quant") if use_quant else ("spectral",)
 
-    def d_fwd(params, spectral, x):
-        return d.apply(
-            {"params": params, "spectral": spectral}, x, mutable=["spectral"]
+    def g_fwd(params, bstats, quant, x, rng=None):
+        rngs = {"dropout": rng} if (use_dropout and rng is not None) else None
+        variables = {"params": params, "batch_stats": bstats}
+        mut = ["batch_stats"]
+        if use_quant:
+            variables["quant"] = quant
+            mut.append("quant")
+        out, v = g.apply(variables, x, True, mutable=mut, rngs=rngs)
+        return out, v["batch_stats"], (v.get("quant", {}) if use_quant
+                                       else None)
+
+    def d_fwd(params, dvars, x):
+        out, mut = d.apply(
+            {"params": params, **dvars}, x, mutable=list(d_colls)
         )
+        return out, {k: mut.get(k, {}) for k in d_colls}
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         real_a = batch["input"]
@@ -192,10 +204,11 @@ def build_train_step(
         # var/mean primal diverges after the first norm), silently doubling
         # the cityscapes/pix2pixHD generator cost.
         def g_primal(params_g):
-            out, vg = g_fwd(params_g, state.batch_stats_g, g_input, drop_rng)
-            return out, vg["batch_stats"]
+            out, bs, qg = g_fwd(params_g, state.batch_stats_g, state.quant_g,
+                                g_input, drop_rng)
+            return out, (bs, qg)
 
-        fake_b_primal, g_vjp, bs_g1 = jax.vjp(
+        fake_b_primal, g_vjp, (bs_g1, quant_g1) = jax.vjp(
             g_primal, state.params_g, has_aux=True
         )
 
@@ -241,7 +254,14 @@ def build_train_step(
             if L.lambda_angular > 0:
                 from p2p_tpu.ops.sobel import angular_loss
 
-                l_ang = angular_loss(real_b, fake_b) * L.lambda_angular
+                # The reference's commented experiment (train.py:356-360)
+                # compares ILLUMINATION QUOTIENTS, not raw images:
+                #   illum_gt   = real_a / max(real_b, 1e-4)
+                #   illum_pred = real_a / max(fake_b, 1e-4)
+                eps = jnp.asarray(1e-4, real_b.dtype)
+                illum_gt = real_a / jnp.maximum(real_b, eps)
+                illum_pred = real_a / jnp.maximum(fake_b, eps)
+                l_ang = angular_loss(illum_gt, illum_pred) * L.lambda_angular
                 parts["g_angular"] = l_ang
                 total = total + l_ang
             if L.lambda_sobel > 0:
@@ -281,9 +301,12 @@ def build_train_step(
             # forward was tried and measured SLOWER on v5e: the doubled
             # batch worsened the big D convs' backward tiling by ~6
             # ms/step at bs=128.)
-            loss_d, grads_d, pred_fake, pred_real, spectral2, pull = (
+            dvars0 = {"spectral": state.spectral_d}
+            if use_quant:
+                dvars0["quant"] = state.quant_d
+            loss_d, grads_d, pred_fake, pred_real, dvars2, pull = (
                 single_forward_d_losses(
-                    d_fwd, state.spectral_d, state.params_d,
+                    d_fwd, dvars0, state.params_d,
                     _concat_pair(real_a, fake_b_primal), real_pair,
                     L.gan_mode,
                 )
@@ -310,16 +333,20 @@ def build_train_step(
             )
             fake_pair = jax.lax.stop_gradient(fake_pair)
 
+            dvars0 = {"spectral": state.spectral_d}
+            if use_quant:
+                dvars0["quant"] = state.quant_d
+
             def loss_d_fn(params_d):
-                pred_fake, s1 = d_fwd(params_d, state.spectral_d, fake_pair)
-                pred_real, s2 = d_fwd(params_d, s1["spectral"], real_pair)
+                pred_fake, v1 = d_fwd(params_d, dvars0, fake_pair)
+                pred_real, v2 = d_fwd(params_d, v1, real_pair)
                 loss = 0.5 * (
                     gan_loss(pred_fake, False, L.gan_mode)
                     + gan_loss(pred_real, True, L.gan_mode)
                 )
-                return loss, (s2["spectral"], pred_real)
+                return loss, (v2, pred_real)
 
-            (loss_d, (spectral1, pred_real)), grads_d = jax.value_and_grad(
+            (loss_d, (dvars1, pred_real)), grads_d = jax.value_and_grad(
                 loss_d_fn, has_aux=True
             )(state.params_d)
             pred_real = jax.tree_util.tree_map(
@@ -327,19 +354,21 @@ def build_train_step(
             )
 
             def loss_g_fn(fake_b):
-                pred_fake_g, s3 = d_fwd(
+                pred_fake_g, v3 = d_fwd(
                     jax.lax.stop_gradient(state.params_d),
-                    spectral1,
+                    dvars1,
                     _concat_pair(real_a, fake_b),
                 )
                 total, parts = g_losses(fake_b, pred_fake_g)
-                return total, (s3["spectral"], parts)
+                return total, (v3, parts)
 
-            (loss_g, (spectral2, g_parts)), grad_fake = jax.value_and_grad(
+            (loss_g, (dvars2, g_parts)), grad_fake = jax.value_and_grad(
                 loss_g_fn, has_aux=True
             )(fake_b_primal)
 
         (grads_g,) = g_vjp(grad_fake)
+        spectral2 = dvars2["spectral"]
+        quant_d1 = dvars2.get("quant") if use_quant else None
 
         # ---- 4. apply G then D updates (reference order) ----------------
         # lr_scale: Adam updates are linear in lr, so the host-driven
@@ -361,7 +390,7 @@ def build_train_step(
                 cq, _ = compressed_fn(params_c)
                 c_rng = (jax.random.fold_in(drop_rng, 1)
                          if drop_rng is not None else None)
-                fake_ac, vg2 = g_fwd(params_g1, bs_g1, cq, c_rng)
+                fake_ac, bs2, _ = g_fwd(params_g1, bs_g1, quant_g1, cq, c_rng)
                 loss = jnp.mean(
                     (fake_ac.astype(jnp.float32) - real_b.astype(jnp.float32)) ** 2
                 )
@@ -369,7 +398,7 @@ def build_train_step(
                     loss = loss + vgg_loss(
                         vgg_params, cq, real_b, L.vgg_imagenet_norm
                     ) * L.lambda_vgg
-                return loss, vg2["batch_stats"]
+                return loss, bs2
 
             (loss_c, bs_g2), grads_c = jax.value_and_grad(
                 loss_c_fn, has_aux=True
@@ -391,6 +420,8 @@ def build_train_step(
             opt_c=opt_c1,
             pool=pool1,
             pool_n=pool_n1,
+            quant_g=quant_g1,
+            quant_d=quant_d1,
         )
         metrics = {
             "loss_d": loss_d.astype(jnp.float32),
@@ -398,6 +429,21 @@ def build_train_step(
             "loss_c": loss_c,
             **{k: v.astype(jnp.float32) for k, v in g_parts.items()},
         }
+        if cfg.optim.grad_clip > 0:
+            # the _zero_nonfinite guard silently drops inf/NaN gradient
+            # entries; surface the count so a sustained blowup is visible
+            # in the metrics stream instead of masked (tiny reduction over
+            # param-sized trees — off the headline path, which has clip=0)
+            from p2p_tpu.train.state import count_nonfinite
+
+            metrics["nonfinite_g"] = count_nonfinite(grads_g).astype(
+                jnp.float32)
+            metrics["nonfinite_d"] = count_nonfinite(grads_d).astype(
+                jnp.float32)
+            if use_c:
+                # the same guard sits in opt_c's chain — count it too
+                metrics["nonfinite_c"] = count_nonfinite(grads_c).astype(
+                    jnp.float32)
         return new_state, metrics
 
     if jit:
@@ -458,10 +504,11 @@ def build_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
             g_in = quantize(raw, bits)
         else:
             g_in = real_a
-        pred = g.apply(
-            {"params": state.params_g, "batch_stats": state.batch_stats_g},
-            g_in, False,
-        )
+        g_vars = {"params": state.params_g,
+                  "batch_stats": state.batch_stats_g}
+        if cfg.model.int8_delayed:
+            g_vars["quant"] = state.quant_g
+        pred = g.apply(g_vars, g_in, False)
         # Per-image vectors so the driver can report the reference's
         # mean AND max over individual test images (train.py:498-502)
         # even at test_batch_size > 1.

@@ -60,6 +60,15 @@ def _clip_pair(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 def build_video_models(cfg: Config, train_dtype=None):
+    if cfg.model.int8_delayed:
+        # the video step threads only the 'spectral' collection through
+        # its D applies (d_fwd/dt_fwd below); delayed scaling needs the
+        # 'quant' amax state threaded like train/step.py does. Fail with
+        # a clear message instead of an obscure flax collection error.
+        raise ValueError(
+            "--int8_delayed is supported on image presets only "
+            "(the video step does not thread the 'quant' collection); "
+            "use dynamic-scale --int8 for video presets")
     g = define_G(cfg.model, dtype=train_dtype, remat=cfg.parallel.remat)
     d = define_D(cfg.model, dtype=train_dtype)
     dt = MultiscaleTemporalDiscriminator(
@@ -131,15 +140,19 @@ def build_video_train_step(
         )
         return out, v["batch_stats"]
 
-    def d_fwd(params, spectral, x):
-        return d.apply(
-            {"params": params, "spectral": spectral}, x, mutable=["spectral"]
+    # dict-of-collections convention shared with train/step.py's
+    # single_forward_d_losses (video presets thread 'spectral' only)
+    def d_fwd(params, dvars, x):
+        out, mut = d.apply(
+            {"params": params, **dvars}, x, mutable=["spectral"]
         )
+        return out, {"spectral": mut["spectral"]}
 
-    def dt_fwd(params, spectral, x):
-        return dt.apply(
-            {"params": params, "spectral": spectral}, x, mutable=["spectral"]
+    def dt_fwd(params, dvars, x):
+        out, mut = dt.apply(
+            {"params": params, **dvars}, x, mutable=["spectral"]
         )
+        return out, {"spectral": mut["spectral"]}
 
     def step(state: VideoTrainState, batch: Dict[str, jax.Array]):
         real_a = batch["input"]    # NTHWC conditioning clip
@@ -170,22 +183,24 @@ def build_video_train_step(
         # D loss (params cotangent) and the G loss (pair cotangent) — the
         # shared single-forward structure of train/step.py. Power
         # iteration advances 2×/step per discriminator, not 3×.
-        loss_d, grads_d, pred_fake, pred_real, spectral2, pull_d = (
+        loss_d, grads_d, pred_fake, pred_real, dv2, pull_d = (
             single_forward_d_losses(
-                d_fwd, state.spectral_d, state.params_d,
+                d_fwd, {"spectral": state.spectral_d}, state.params_d,
                 jnp.concatenate([a_f, fake_f], axis=-1),
                 jnp.concatenate([a_f, b_f], axis=-1),
                 L.gan_mode,
             )
         )
-        loss_dt, grads_dt, pred_fake_t, pred_real_t, spectral_t2, pull_dt = (
+        loss_dt, grads_dt, pred_fake_t, pred_real_t, dvt2, pull_dt = (
             single_forward_d_losses(
-                dt_fwd, state.spectral_dt, state.params_dt,
+                dt_fwd, {"spectral": state.spectral_dt}, state.params_dt,
                 _clip_pair(real_a, fake_clip),
                 _clip_pair(real_a, real_b),
                 L.gan_mode,
             )
         )
+        spectral2 = dv2["spectral"]
+        spectral_t2 = dvt2["spectral"]
 
         # ---- G losses on the primal fake + the shared D outputs -----------
         def g_losses(fake, pred_fake_g, pred_fake_tg):

@@ -13,7 +13,14 @@ from PIL import Image
 
 
 def to_uint8_img(x) -> np.ndarray:
-    """[-1,1] float HWC → uint8 HWC."""
+    """[-1,1] float HWC → uint8 HWC. uint8 input passes through unscaled
+    (already-converted images, e.g. the masking experiment's AND output)."""
+    if isinstance(x, np.ndarray) and x.dtype == np.uint8:
+        if x.ndim == 4:
+            if x.shape[0] != 1:
+                raise ValueError(f"expected single image, got batch {x.shape}")
+            return x[0]
+        return x
     arr = np.asarray(x, np.float32)
     if arr.ndim == 4:
         if arr.shape[0] != 1:

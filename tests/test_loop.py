@@ -106,3 +106,142 @@ def test_trainer_scan_steps_covers_every_batch(tmp_path):
     metrics = tr.train_epoch()
     assert int(tr.state.step) == 5
     assert np.isfinite(metrics["loss_g"])
+
+
+# --------------------------------------------------- accounting fixtures
+class _FakeClock:
+    """Deterministic perf_counter: +1.0 per call. Makes train_epoch's
+    throughput math hand-computable (VERDICT r2 item 6: a miscount here
+    silently corrupts the headline img/s figure)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+def _fake_steps():
+    """(train_step, multi_step) fakes: advance state.step, constant
+    metrics, zero wall time (the fake clock owns time entirely)."""
+    import jax.numpy as jnp
+
+    def train_step(state, batch):
+        return state.replace(step=state.step + 1), {
+            "loss_g": jnp.float32(1.0), "loss_d": jnp.float32(2.0)}
+
+    def multi_step(state, batches):
+        k = next(iter(batches.values())).shape[0]
+        return state.replace(step=state.step + k), {
+            "loss_g": jnp.ones((k,), jnp.float32),
+            "loss_d": jnp.full((k,), 2.0, jnp.float32)}
+
+    return train_step, multi_step
+
+
+def _accounting_trainer(tmp_path, n_train, batch_size, scan_steps,
+                        monkeypatch):
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.train import loop as loop_mod
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=n_train, n_test=2, size=16)
+    cfg = get_preset("facades")
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, ngf=4, ndf=4),
+        data=dataclasses.replace(cfg.data, batch_size=batch_size,
+                                 image_size=16, threads=0),
+        train=dataclasses.replace(cfg.train, mixed_precision=False,
+                                  scan_steps=scan_steps, log_every=1000),
+    )
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path))
+    clock = _FakeClock()
+    monkeypatch.setattr(loop_mod.time, "perf_counter", clock)
+    train_step, multi_step = _fake_steps()
+    tr.train_step = train_step
+    tr.multi_step = multi_step if scan_steps > 1 else None
+    return tr
+
+
+def test_train_epoch_throughput_math_scan_with_remainder(
+        tmp_path, monkeypatch):
+    """K=2 over 5 batches: 2 scanned dispatches + 1 single-step remainder.
+
+    Fake-clock trace (+1 per perf_counter call):
+      t0=1 | d1: call=2, first -> t0=3 | d2: call=4 | d3 (k=1, new
+      dispatch shape): call=5, skew=6-5=1 | end=7.
+    elapsed = 7 - 3 - 1(skew) = 3; steps counted = 5 - first_k(2) = 3
+    -> img_per_sec = 3*bs/3 = bs exactly. The remainder dispatch's
+    compile block lands in compile_skew, NOT in throughput."""
+    tr = _accounting_trainer(tmp_path, n_train=10, batch_size=2,
+                             scan_steps=2, monkeypatch=monkeypatch)
+    out = tr.train_epoch()
+    assert int(tr.state.step) == 5
+    assert out["img_per_sec"] == pytest.approx(2.0)
+    # metric averages cover every step
+    assert out["loss_g"] == pytest.approx(1.0)
+    assert out["loss_d"] == pytest.approx(2.0)
+
+
+def test_train_epoch_throughput_math_single_step(tmp_path, monkeypatch):
+    """K=1 over 3 batches: first dispatch excluded (compile), no skew.
+      t0=1 | d1: call=2, first -> t0=3 | d2: call=4 | d3: call=5 | end=6
+    elapsed = 6-3 = 3; counted steps = 3-1 = 2 -> 2*bs/3."""
+    tr = _accounting_trainer(tmp_path, n_train=6, batch_size=2,
+                             scan_steps=1, monkeypatch=monkeypatch)
+    out = tr.train_epoch()
+    assert int(tr.state.step) == 3
+    assert out["img_per_sec"] == pytest.approx(2 * 2 / 3.0)
+
+
+def test_train_epoch_all_scanned_no_remainder(tmp_path, monkeypatch):
+    """K=2 over exactly 4 batches: no remainder path, skew must stay 0.
+      t0=1 | d1: call=2, first -> t0=3 | d2: call=4 | end=5
+    elapsed = 5-3 = 2; counted = 4-2 = 2 -> 2*bs/2 = bs."""
+    tr = _accounting_trainer(tmp_path, n_train=8, batch_size=2,
+                             scan_steps=2, monkeypatch=monkeypatch)
+    out = tr.train_epoch()
+    assert int(tr.state.step) == 4
+    assert out["img_per_sec"] == pytest.approx(2.0)
+
+
+@pytest.mark.slow
+def test_evaluate_pad_and_trim_across_data_shards(tmp_path):
+    """5 test images, test_batch_size=2, data=2 mesh: the odd tail batch
+    is edge-padded to split across shards, and the padded duplicate must
+    NOT be scored — exactly 5 per-image metrics come back."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=2, n_test=5, size=16)
+    cfg = get_preset("facades")
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, ngf=4, ndf=4),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=16,
+                                 test_batch_size=2, threads=0),
+        parallel=dataclasses.replace(
+            cfg.parallel, mesh=MeshSpec(data=2)),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+    )
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path))
+    result = tr.evaluate()
+    assert result["n_images"] == 5
+    assert np.isfinite(result["psnr_mean"])
+    # padding by edge-repeat then trimming means the mean over 5 equals
+    # the mean of the 5 individual scores — recompute via a second pass
+    # with test_batch_size=5 (no padding needed) and compare.
+    cfg2 = cfg.replace(
+        data=dataclasses.replace(cfg.data, test_batch_size=6),
+        parallel=dataclasses.replace(cfg.parallel, mesh=MeshSpec(data=1)),
+    )
+    tr2 = Trainer(cfg2, data_root=root, workdir=str(tmp_path))
+    tr2.state = tr.state
+    result2 = tr2.evaluate()
+    assert result2["n_images"] == 5
+    assert result["psnr_mean"] == pytest.approx(result2["psnr_mean"],
+                                                rel=1e-4)

@@ -452,3 +452,60 @@ def test_sobel_loss_term_and_warmup():
     _, m0 = step0(state0, b)
     assert float(mw["g_sobel"]) == pytest.approx(
         0.25 * float(m0["g_sobel"]), rel=1e-5)
+
+
+def test_angular_loss_uses_illumination_quotients():
+    """The reference's commented angular experiment (train.py:356-360)
+    compares real_a/max(real_b,eps) vs real_a/max(fake_b,eps) — NOT raw
+    images. With the compression net active, fake_b is a function of
+    real_b only, so changing real_a must change g_angular (the raw-image
+    form ignored real_a entirely)."""
+    import dataclasses
+
+    cfg = tiny_config()
+    assert cfg.model.use_compression_net
+    cfg = cfg.replace(loss=dataclasses.replace(cfg.loss, lambda_angular=2.0))
+    b1 = {k: jnp.asarray(v) for k, v in synthetic_batch(2, 32).items()}
+    # second batch: same target (→ identical fake_b), different input
+    b2 = dict(b1)
+    b2["input"] = jnp.roll(b1["input"], 7, axis=1) * 0.5 + 0.1
+    step_fn = build_train_step(cfg, None, 1, None, jit=True)
+    state = create_train_state(cfg, jax.random.key(0), b1, 1)
+    _, m1 = step_fn(state, b1)
+    state = create_train_state(cfg, jax.random.key(0), b1, 1)
+    _, m2 = step_fn(state, b2)
+    a1, a2 = float(m1["g_angular"]), float(m2["g_angular"])
+    assert np.isfinite(a1) and np.isfinite(a2) and a1 > 0
+    assert a1 != pytest.approx(a2, rel=1e-4)
+
+
+def test_nonfinite_grad_counter_surfaces_in_metrics():
+    """grad_clip>0 activates the zero-nonfinite guard; the step must
+    surface how many entries it dropped (ADVICE r2: silent masking)."""
+    import dataclasses
+
+    cfg = tiny_config()
+    cfg = cfg.replace(optim=dataclasses.replace(cfg.optim, grad_clip=1.0))
+    b = {k: jnp.asarray(v) for k, v in synthetic_batch(2, 32).items()}
+    state = create_train_state(cfg, jax.random.key(0), b, 1)
+    step_fn = build_train_step(cfg, None, 1, None, jit=True)
+    _, m = step_fn(state, b)
+    assert m["nonfinite_g"].shape == () and m["nonfinite_d"].shape == ()
+    assert float(m["nonfinite_g"]) == 0.0  # healthy step drops nothing
+    assert float(m["nonfinite_d"]) == 0.0
+    # a clip=0 step must NOT pay for the counter
+    cfg0 = cfg.replace(optim=dataclasses.replace(cfg.optim, grad_clip=0.0))
+    state0 = create_train_state(cfg0, jax.random.key(0), b, 1)
+    _, m0 = build_train_step(cfg0, None, 1, None, jit=True)(state0, b)
+    assert "nonfinite_g" not in m0
+
+
+def test_count_nonfinite_counts_exactly():
+    from p2p_tpu.train.state import count_nonfinite
+
+    tree = {
+        "a": jnp.array([1.0, jnp.inf, -jnp.inf]),
+        "b": jnp.array([[jnp.nan, 0.0], [2.0, jnp.nan]]),
+    }
+    assert int(count_nonfinite(tree)) == 4
+    assert int(count_nonfinite({})) == 0
