@@ -29,6 +29,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="parallel decode workers (0 = inline)")
     p.add_argument("--crop_size", type=int, default=256,
                    help="tile size; -1 disables tiling (whole images)")
+    p.add_argument("--crop_width", type=int, default=0,
+                   help="rectangular tile width (0 = square crop_size); "
+                        "e.g. --crop_size 512 --crop_width 1024 for "
+                        "pix2pixHD-shaped frames (TPU extension; the "
+                        "reference datagen is square-only)")
     p.add_argument("--img_format", type=str, default="png",
                    help="accepted for parity; outputs are always png")
     p.add_argument("--min_std", type=float, default=0.0,
@@ -51,6 +56,7 @@ def main(argv=None) -> int:
         upsample=args.upsampling,
         workers=args.pool_size,
         min_std=args.min_std,
+        crop_width=args.crop_width if args.crop_width > 0 else None,
     )
     print(f"wrote {n} paired patches to {args.target_dataset_folder}/{args.split}")
     return 0

@@ -42,13 +42,19 @@ def compress_uint8(img: np.ndarray, bits: int = 3) -> np.ndarray:
     return np.round(q * 255.0).astype(np.uint8)
 
 
-def _tile(img: np.ndarray, crop: int) -> np.ndarray:
-    """Trim to a multiple of ``crop`` and tile: (H,W,C) -> (T, crop, crop, C)."""
+def _tile(img: np.ndarray, crop: int, crop_w: Optional[int] = None) -> np.ndarray:
+    """Trim to a multiple of the crop and tile: (H,W,C) -> (T, ch, cw, C).
+
+    ``crop_w`` admits rectangular patches (e.g. 512×1024 pix2pixHD
+    frames); the reference's datagen is square-only (its crop_size is a
+    single int) — this is the TPU framework's HD-dataset extension.
+    """
+    cw = crop_w or crop
     h, w, c = img.shape
-    th, tw = (h // crop) * crop, (w // crop) * crop
+    th, tw = (h // crop) * crop, (w // cw) * cw
     img = img[:th, :tw]
-    t = img.reshape(th // crop, crop, tw // crop, crop, c)
-    return t.transpose(0, 2, 1, 3, 4).reshape(-1, crop, crop, c)
+    t = img.reshape(th // crop, crop, tw // cw, cw, c)
+    return t.transpose(0, 2, 1, 3, 4).reshape(-1, crop, cw, c)
 
 
 def generate_patches(
@@ -60,6 +66,7 @@ def generate_patches(
     bits: int = 3,
     upsample: int = 0,
     min_std: float = 0.0,
+    crop_width: Optional[int] = None,
 ) -> int:
     """Tile one source image into paired patches. Returns patches written.
 
@@ -81,9 +88,10 @@ def generate_patches(
         # whole-image mode (reference --crop_size -1)
         tiles = [arr]
     else:
-        if arr.shape[0] < crop_size or arr.shape[1] < crop_size:
+        cw = crop_width or crop_size
+        if arr.shape[0] < crop_size or arr.shape[1] < cw:
             return 0
-        tiles = _tile(arr, crop_size)
+        tiles = _tile(arr, crop_size, crop_width)
         if min_std > 0:
             tiles = [t for t in tiles
                      if float(t.astype(np.float32).std()) >= min_std]
@@ -106,6 +114,7 @@ def generate_dataset(
     upsample: int = 0,
     workers: int = 0,
     min_std: float = 0.0,
+    crop_width: Optional[int] = None,
 ) -> int:
     """Generate <out>/<split>/{a,b}/ from every image under src_dir."""
     a_dir = os.path.join(out_dir, split, "a")
@@ -118,7 +127,7 @@ def generate_dataset(
         os.path.join(src_dir, f) for f in os.listdir(src_dir) if is_image_file(f)
     )
     args = [(s, a_dir, b_dir, crop_size, max_patches, bits, upsample,
-             min_std) for s in sources]
+             min_std, crop_width) for s in sources]
     if workers and len(sources) > 1:
         with ProcessPoolExecutor(max_workers=workers) as pool:
             counts = list(pool.map(_gen_star, args))

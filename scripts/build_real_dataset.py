@@ -51,6 +51,9 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="dataset")
     ap.add_argument("--name", default="real256")
     ap.add_argument("--crop", type=int, default=256)
+    ap.add_argument("--crop_w", type=int, default=0,
+                    help="rectangular tile width (0 = square --crop); "
+                         "--crop 512 --crop_w 1024 builds a pix2pixHD set")
     ap.add_argument("--bit_size", type=int, default=3)
     ap.add_argument("--test_frac", type=float, default=0.15)
     ap.add_argument("--max_patches", type=int, default=24)
@@ -96,6 +99,7 @@ def main(argv=None) -> int:
             "--max_patches", str(args.max_patches),
             "--upsampling", str(args.upsampling),
             "--min_std", str(args.min_std),
+            "--crop_width", str(args.crop_w),
         ])
         if rc:
             return rc

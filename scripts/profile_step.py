@@ -52,6 +52,9 @@ def capture(args) -> str:
     bs = args.bs or cfg.data.batch_size
     cfg = cfg.replace(data=dataclasses.replace(
         cfg.data, batch_size=bs, image_size=h, image_width=w))
+    if args.delayed:
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, int8_delayed=True))
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
     host = synthetic_batch(batch_size=bs, size=h, width=w,
@@ -144,13 +147,17 @@ def main() -> None:
                     help="square image override (default: preset dims)")
     ap.add_argument("--steps", type=int, default=8,
                     help="scanned steps inside the traced dispatch")
+    ap.add_argument("--delayed", action="store_true",
+                    help="stored-scale int8 activation quantization")
+    ap.add_argument("--top", type=int, default=12,
+                    help="kernels to print in the per-kernel table")
     ap.add_argument("--logdir", default="/tmp/p2p_tpu_profile")
     ap.add_argument("--trace", default=None,
                     help="summarize an existing trace.json.gz instead")
     args = ap.parse_args()
     path = args.trace or capture(args)
     print(f"trace: {path}")
-    summarize(path, args.steps)
+    summarize(path, args.steps, top=args.top)
 
 
 if __name__ == "__main__":

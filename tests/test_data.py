@@ -268,3 +268,24 @@ def test_grad_clip_optimizer_bounds_update():
     blown = {"w": jnp.full(4, jnp.inf)}
     ups, _ = opt.update(blown, st2, params)
     assert np.isfinite(np.asarray(ups["w"])).all()
+
+
+def test_generate_dataset_rectangular_crop(tmp_path):
+    """crop_width admits pix2pixHD-shaped (H, 2H) tiles; content matches
+    the corresponding region of the source (row-major tile order)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 256, (70, 140, 3)).astype(np.uint8)
+    Image.fromarray(arr).save(src / "img.png")
+    out = tmp_path / "out"
+    n = generate_dataset(str(src), str(out), split="train", crop_size=32,
+                         crop_width=64)
+    # 70x140 → 2 rows × 2 cols of 32x64 tiles
+    assert n == 4
+    a_files = sorted(os.listdir(out / "train" / "a"))
+    a0 = np.asarray(Image.open(out / "train" / "a" / a_files[0]))
+    assert a0.shape == (32, 64, 3)
+    np.testing.assert_array_equal(a0, arr[:32, :64])
+    b0 = np.asarray(Image.open(out / "train" / "b" / a_files[0]))
+    np.testing.assert_array_equal(b0, compress_uint8(a0, 3))
