@@ -127,7 +127,10 @@ def main() -> None:
     img_per_sec = bs * scan_k * n_calls / elapsed
     baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 pix2pix
     comparable = on_tpu and img == 256 and preset in (
-        "facades", "facades_int8", "edges2shoes_dp"
+        "facades", "facades_int8", "edges2shoes_dp",
+        # suffix order as generated above: INT8 → DELAYED → I8DEC
+        "facades_int8_ds", "facades_int8_i8gd", "facades_int8_i8gd_ds",
+        "facades_int8_i8dec", "facades_int8_ds_i8dec",
     )
     dims = f"{img}x{wid}" if wid else f"{img}px"
     record = {

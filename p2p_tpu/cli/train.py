@@ -57,6 +57,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-layer amax carried in TrainState; removes "
                         "the absmax reductions from the critical path "
                         "(ops/int8.py int8_conv_ds)")
+    p.add_argument("--thin_head", action="store_true", default=None,
+                   help="U-Net image head as the kn2row subpixel form "
+                        "(measured slower on v5e; see "
+                        "ModelConfig.thin_head)")
+    p.add_argument("--legacy_layout", action="store_true", default=None,
+                   help="keep the dead conv biases in front of norm "
+                        "layers (round-2 checkpoint layout; see "
+                        "ModelConfig.legacy_layout)")
     # --- reference flags (train.py:133-157), same names/defaults ---------
     p.add_argument("--dataset", type=str, default=None, help="facades")
     p.add_argument("--name", type=str, default=None, help="training name")
@@ -145,7 +153,9 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  ngf=args.ngf, ndf=args.ndf, n_blocks=args.n_blocks,
                  upsample_mode=args.upsample_mode, int8=args.int8,
                  int8_generator=args.int8_generator,
-                 int8_delayed=args.int8_delayed)
+                 int8_delayed=args.int8_delayed,
+                 legacy_layout=args.legacy_layout,
+                 thin_head=args.thin_head)
     loss = over(loss, lambda_l1=args.lamb, lambda_vgg=args.lambda_vgg,
                 lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv,
                 lambda_sobel=args.lambda_sobel,

@@ -89,6 +89,19 @@ class ModelConfig:
     # int8_conv_ds). Transient clipping after an activation spike decays
     # in one step (decaying-max update).
     int8_delayed: bool = False
+    # Keep the mathematically-dead conv biases in front of mean-
+    # subtracting norms (round-2 checkpoint param layout). Default False:
+    # those biases are exactly cancelled by the norm in forward AND
+    # receive identically-zero gradients (the norm backward emits
+    # zero-channel-mean cotangents), yet computing those zero gradients
+    # re-read full-size cotangents (~3 ms/step at bs=128/256²).
+    legacy_layout: bool = False
+    # U-Net image head as the kn2row subpixel form instead of
+    # ConvTranspose. Measured SLOWER on v5e (1538 vs 1681 img/s at
+    # 256²/bs=128 — XLA's fused deconv wins); reachable for other
+    # chips/shapes. Exact weight mapping between the layouts is pinned
+    # in tests/test_models.py.
+    thin_head: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -73,7 +73,11 @@ class CompressionDecoder(nn.Module):
         # same block as the resnet G family (networks.py:292-319 matches
         # the classic no-post-add-activation shape)
         for _ in range(self.n_blocks):
-            y = ResnetBlock(f_top, norm="instance", dtype=self.dtype)(y)
+            # legacy_layout pinned: this module mirrors the reference's
+            # commented-out AE verbatim (biases and all) and is not on a
+            # perf-critical path — keep its param tree stable
+            y = ResnetBlock(f_top, norm="instance", legacy_layout=True,
+                            dtype=self.dtype)(y)
         y = y + head  # long skip (networks.py:375)
         for i in reversed(range(self.n_up)):
             f = self.ngf * (2 ** i)

@@ -43,17 +43,21 @@ class ResidualBlock(nn.Module):
     norm: str = "batch"
     int8: bool = False
     int8_delayed: bool = False
+    # see UNetGenerator.legacy_layout: conv biases before mean-subtracting
+    # norms are exactly dead; default drops them (True = round-2 layout)
+    legacy_layout: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        ub = self.legacy_layout or self.norm == "none"
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
-                      dtype=self.dtype)(x)
+                      use_bias=ub, dtype=self.dtype)(x)
         y = mk()(y)
         y = relu_y(y)
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
-                      dtype=self.dtype)(y)
+                      use_bias=ub, dtype=self.dtype)(y)
         y = mk()(y)
         return relu_y(y + x)
 
@@ -68,31 +72,42 @@ class ExpandNetwork(nn.Module):
     # head stay bf16)
     int8: bool = False
     int8_delayed: bool = False
+    legacy_layout: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        # EVERY conv here (head included, networks.py:471-475 BN after the
+        # k9 head) is norm-followed → all conv biases are dead
+        ub = self.legacy_layout or self.norm == "none"
         act = PReLU()  # single shared learned scalar, as in the reference
 
         y = pixel_unshuffle(x, 2)
         y = upsample_nearest(y, 2)
 
-        y = act(mk()(ConvLayer(self.ngf, kernel_size=9, dtype=self.dtype)(y)))
-        y = act(mk()(ConvLayer(self.ngf * 2, kernel_size=3, stride=2, dtype=self.dtype)(y)))
-        y = act(mk()(ConvLayer(self.ngf * 4, kernel_size=3, stride=2, dtype=self.dtype)(y)))
+        y = act(mk()(ConvLayer(self.ngf, kernel_size=9, use_bias=ub,
+                               dtype=self.dtype)(y)))
+        y = act(mk()(ConvLayer(self.ngf * 2, kernel_size=3, stride=2,
+                               use_bias=ub, dtype=self.dtype)(y)))
+        y = act(mk()(ConvLayer(self.ngf * 4, kernel_size=3, stride=2,
+                               use_bias=ub, dtype=self.dtype)(y)))
 
         block_cls = remat_wrap(ResidualBlock, self.remat)
         residual = y
         for i in range(self.n_blocks):
             # explicit name: remat wrapping must not change param paths
             y = block_cls(self.ngf * 4, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
-                          dtype=self.dtype,
+                          legacy_layout=self.legacy_layout, dtype=self.dtype,
                           name=f"ResidualBlock_{i}")(y, train)
         y = leaky_relu_y(y + residual, 0.2)
 
-        y = act(mk()(UpsampleConvLayer(self.ngf * 2, kernel_size=3, upsample=2, dtype=self.dtype)(y)))
-        y = act(mk()(UpsampleConvLayer(self.ngf, kernel_size=3, upsample=2, dtype=self.dtype)(y)))
-        y = UpsampleConvLayer(self.out_channels, kernel_size=9, dtype=self.dtype)(y)
+        y = act(mk()(UpsampleConvLayer(self.ngf * 2, kernel_size=3,
+                                       upsample=2, use_bias=ub,
+                                       dtype=self.dtype)(y)))
+        y = act(mk()(UpsampleConvLayer(self.ngf, kernel_size=3, upsample=2,
+                                       use_bias=ub, dtype=self.dtype)(y)))
+        y = UpsampleConvLayer(self.out_channels, kernel_size=9, use_bias=ub,
+                              dtype=self.dtype)(y)
         y = mk()(y)
         return tanh_y(y)

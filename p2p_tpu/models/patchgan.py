@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from p2p_tpu.ops.activations import leaky_relu_y
-from p2p_tpu.ops.conv import normal_init, save_conv_out
+from p2p_tpu.ops.conv import KN2RowConv, normal_init, save_conv_out
 from p2p_tpu.ops.spectral_norm import SpectralConv
 
 
@@ -59,6 +59,13 @@ class _PlainConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        if self.stride == 1 and self.features * 16 <= x.shape[-1]:
+            # thin head (e.g. 512→1): kn2row matmul decomposition — the
+            # MXU conv runs at 3-6 TF/s with one live output lane; this
+            # form is one full-rate HBM pass over x (ops/conv.py).
+            return KN2RowConv(self.features, kernel_size=4,
+                              padding=self.padding, dtype=self.dtype,
+                              name="Conv_0")(x)
         if self.int8:
             from p2p_tpu.ops.int8 import QuantConv
 

@@ -45,6 +45,7 @@ def GlobalGenerator(
     remat: Union[bool, str] = False,
     int8: bool = False,
     int8_delayed: bool = False,
+    legacy_layout: bool = False,
     dtype=None,
     name: Optional[str] = None,
 ) -> ResnetGenerator:
@@ -54,7 +55,8 @@ def GlobalGenerator(
         ngf=ngf, n_blocks=n_blocks, out_channels=out_channels,
         n_downsampling=4, norm=norm, max_features=1024,
         return_features=return_features, remat=remat, int8=int8,
-        int8_delayed=int8_delayed, dtype=dtype, name=name,
+        int8_delayed=int8_delayed, legacy_layout=legacy_layout, dtype=dtype,
+        name=name,
     )
 
 
@@ -70,11 +72,15 @@ class Pix2PixHDGenerator(nn.Module):
     # int8 MXU path for the G1 trunk + local enhancer ResnetBlocks
     int8: bool = False
     int8_delayed: bool = False
+    # see UNetGenerator.legacy_layout: conv biases before mean-subtracting
+    # norms are exactly dead; default drops them (True = round-2 layout)
+    legacy_layout: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        ub = self.legacy_layout or self.norm == "none"
         ngf_local = self.ngf // 2
 
         # G1 on the avg-pooled half-res input, pre-output features
@@ -82,13 +88,15 @@ class Pix2PixHDGenerator(nn.Module):
         g1_feats = GlobalGenerator(
             ngf=self.ngf, n_blocks=self.n_blocks_global, norm=self.norm,
             return_features=True, remat=self.remat, int8=self.int8, int8_delayed=self.int8_delayed,
-            dtype=self.dtype, name="global",
+            legacy_layout=self.legacy_layout, dtype=self.dtype, name="global",
         )(x_half, train)
 
         # G2 front end on the full-res input, down to half res
-        y = ConvLayer(ngf_local, kernel_size=7, dtype=self.dtype)(x)
+        y = ConvLayer(ngf_local, kernel_size=7, use_bias=ub,
+                      dtype=self.dtype)(x)
         y = relu_y(mk()(y))
-        y = ConvLayer(self.ngf, kernel_size=3, stride=2, dtype=self.dtype)(y)
+        y = ConvLayer(self.ngf, kernel_size=3, stride=2, use_bias=ub,
+                      dtype=self.dtype)(y)
         y = relu_y(mk()(y))
 
         # fuse + local trunk
@@ -97,11 +105,11 @@ class Pix2PixHDGenerator(nn.Module):
         for i in range(self.n_blocks_local):
             # explicit name: remat wrapping must not change param paths
             y = block_cls(self.ngf, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
-                          dtype=self.dtype,
+                          legacy_layout=self.legacy_layout, dtype=self.dtype,
                           name=f"ResnetBlock_{i}")(y, train)
 
         y = UpsampleConvLayer(ngf_local, kernel_size=3, upsample=2,
-                              dtype=self.dtype)(y)
+                              use_bias=ub, dtype=self.dtype)(y)
         y = relu_y(mk()(y))
         y = ConvLayer(self.out_channels, kernel_size=7, dtype=self.dtype)(y)
         return tanh_y(y)

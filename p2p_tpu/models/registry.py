@@ -38,6 +38,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             remat=remat,
             int8=int8_g,
             int8_delayed=delayed,
+            legacy_layout=cfg.legacy_layout,
             dtype=dtype,
         )
     if cfg.generator == "unet":
@@ -49,6 +50,8 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             int8=int8_g and cfg.upsample_mode == "deconv",
             int8_decoder=cfg.int8_decoder,
             int8_delayed=delayed,
+            legacy_layout=cfg.legacy_layout,
+            thin_head=cfg.thin_head,
             dtype=dtype,
         )
     if cfg.generator == "resnet":
@@ -62,6 +65,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             remat=remat,
             int8=int8_g,
             int8_delayed=delayed,
+            legacy_layout=cfg.legacy_layout,
             dtype=dtype,
         )
     if cfg.generator == "pix2pixhd":
@@ -70,7 +74,8 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
         return Pix2PixHDGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc,
             n_blocks_global=cfg.n_blocks, norm=cfg.norm,
-            remat=remat, int8=int8_g, int8_delayed=delayed, dtype=dtype,
+            remat=remat, int8=int8_g, int8_delayed=delayed,
+            legacy_layout=cfg.legacy_layout, dtype=dtype,
         )
     if cfg.generator == "pix2pixhd_global":
         # phase 1 of the coarse-to-fine schedule: G1 alone at half res
@@ -79,7 +84,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
         return GlobalGenerator(
             ngf=cfg.ngf, out_channels=cfg.output_nc, n_blocks=cfg.n_blocks,
             norm=cfg.norm, remat=remat, int8=int8_g, int8_delayed=delayed,
-            dtype=dtype,
+            legacy_layout=cfg.legacy_layout, dtype=dtype,
         )
     raise ValueError(f"unknown generator {cfg.generator!r}")
 

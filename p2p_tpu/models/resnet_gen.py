@@ -37,16 +37,20 @@ class ResnetBlock(nn.Module):
     norm: str = "instance"
     int8: bool = False
     int8_delayed: bool = False
+    # see UNetGenerator.legacy_layout: conv biases before mean-subtracting
+    # norms are exactly dead; default drops them (True = round-2 layout)
+    legacy_layout: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        ub = self.legacy_layout or self.norm == "none"
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
-                      dtype=self.dtype)(x)
+                      use_bias=ub, dtype=self.dtype)(x)
         y = relu_y(mk()(y))
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
-                      dtype=self.dtype)(y)
+                      use_bias=ub, dtype=self.dtype)(y)
         y = mk()(y)
         return x + y
 
@@ -69,18 +73,23 @@ class ResnetGenerator(nn.Module):
     # quality-critical).
     int8: bool = False
     int8_delayed: bool = False
+    legacy_layout: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
         cap = self.max_features or (1 << 30)
+        # every conv below except the head is norm-followed → dead bias
+        ub = self.legacy_layout or self.norm == "none"
 
-        y = ConvLayer(self.ngf, kernel_size=7, dtype=self.dtype)(x)
+        y = ConvLayer(self.ngf, kernel_size=7, use_bias=ub,
+                      dtype=self.dtype)(x)
         y = relu_y(mk()(y))
         for i in range(self.n_downsampling):
             f = min(self.ngf * (2 ** (i + 1)), cap)
-            y = ConvLayer(f, kernel_size=3, stride=2, dtype=self.dtype)(y)
+            y = ConvLayer(f, kernel_size=3, stride=2, use_bias=ub,
+                          dtype=self.dtype)(y)
             y = relu_y(mk()(y))
 
         block_cls = remat_wrap(ResnetBlock, self.remat)
@@ -90,13 +99,13 @@ class ResnetGenerator(nn.Module):
             # (nn.remat's auto-name is 'CheckpointResnetBlock_i', which
             # would silently re-key checkpoints when remat is toggled)
             y = block_cls(f_trunk, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
-                          dtype=self.dtype,
+                          legacy_layout=self.legacy_layout, dtype=self.dtype,
                           name=f"ResnetBlock_{i}")(y, train)
 
         for i in reversed(range(self.n_downsampling)):
             f = min(self.ngf * (2 ** i), cap)
             y = UpsampleConvLayer(f, kernel_size=3, upsample=2,
-                                  dtype=self.dtype)(y)
+                                  use_bias=ub, dtype=self.dtype)(y)
             y = relu_y(mk()(y))
         if self.return_features:
             return y

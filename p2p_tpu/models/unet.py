@@ -56,6 +56,22 @@ class UNetGenerator(nn.Module):
     int8: bool = False
     int8_decoder: bool = False
     int8_delayed: bool = False
+    # Keep the (mathematically dead) conv biases in front of norm layers.
+    # A per-channel bias immediately followed by a mean-subtracting norm
+    # (BatchNorm OR InstanceNorm) is exactly cancelled in the forward
+    # (mean absorbs it), and the norm backward emits zero-channel-mean
+    # cotangents so the bias gradient is identically ~0 — yet computing
+    # it re-reads the full cotangent (profiled ~3 ms/step of reduce_sum
+    # kernels at bs=128/256²). Default: drop those biases (exact same
+    # function, same training dynamics — they initialize at 0 and never
+    # move). True restores the round-2 checkpoint param layout.
+    legacy_layout: bool = False
+    # Image head as kn2row subpixel instead of ConvTranspose. Measured
+    # SLOWER on v5e at 256²/bs=128 (1538 vs 1681 img/s: XLA's fused
+    # deconv beats the extra z-tensor round-trip); kept as an option for
+    # other chips/shapes. tests/test_models.py pins the exact weight
+    # mapping between the two layouts.
+    thin_head: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -74,18 +90,23 @@ class UNetGenerator(nn.Module):
         num_downs = min(self.num_downs, pow2_levels(x.shape[1]),
                         pow2_levels(x.shape[2]))
 
-        def down_conv(y, features, name, int8=False):
+        normed = self.norm != "none" and not self.legacy_layout
+
+        def down_conv(y, features, name, int8=False, norm_after=False):
+            bias = not norm_after
             if int8:
                 from p2p_tpu.ops.int8 import QuantConv
 
                 return QuantConv(
                     features, kernel_size=4, strides=2, padding=1,
-                    dtype=self.dtype, kernel_init=normal_init(), name=name,
+                    use_bias=bias, dtype=self.dtype,
+                    kernel_init=normal_init(), name=name,
                     delayed=self.int8_delayed,
                 )(y)
             return save_conv_out(nn.Conv(
                 features, kernel_size=(4, 4), strides=(2, 2), padding=1,
-                dtype=self.dtype, kernel_init=normal_init(), name=name,
+                use_bias=bias, dtype=self.dtype, kernel_init=normal_init(),
+                name=name,
             )(y))
 
         # ---- encoder ----------------------------------------------------
@@ -97,7 +118,8 @@ class UNetGenerator(nn.Module):
             if i > 0:
                 y = leaky_relu_y(y, 0.2)
             y = down_conv(y, f, name=f"down{i}",
-                          int8=self.int8 and i > 0)
+                          int8=self.int8 and i > 0,
+                          norm_after=normed and 0 < i < num_downs - 1)
             # no norm on the outermost and innermost encoder convs
             if 0 < i < num_downs - 1:
                 y = mk()(y)
@@ -108,6 +130,9 @@ class UNetGenerator(nn.Module):
             f = self.out_channels if i == 0 else feats[i - 1]
             y = relu_y(y)
             if self.upsample_mode == "subpixel":
+                # bias kept: after the shifted interleave it is a per-
+                # PHASE (2×2-periodic) offset, which a norm's global mean
+                # only partially absorbs — not dead, unlike plain convs
                 y = SubpixelDeconv(
                     f, dtype=self.dtype, name=f"up{i}",
                 )(y)
@@ -120,19 +145,32 @@ class UNetGenerator(nn.Module):
                     # wgrad slices cost more than the MXU gain.
                     from p2p_tpu.ops.int8 import QuantSubpixelDeconv
 
+                    # bias kept — per-phase offset, see subpixel note
                     y = QuantSubpixelDeconv(
                         f, dtype=self.dtype, delayed=self.int8_delayed,
                         kernel_init=normal_init(), name=f"up{i}",
                     )(y)
+                elif (i == 0 and self.thin_head
+                      and not self.legacy_layout and 16 * f <= y.shape[-1]):
+                    # image head as the kn2row subpixel form (see
+                    # thin_head doc — off by default on v5e)
+                    y = SubpixelDeconv(
+                        f, thin=True, dtype=self.dtype,
+                        kernel_init=normal_init(), name=f"up{i}",
+                    )(y)
                 else:
+                    # bias dropped when a norm follows (i>0): the norm's
+                    # mean subtraction cancels it exactly (see legacy_layout)
                     y = save_conv_out(nn.ConvTranspose(
                         f, kernel_size=(4, 4), strides=(2, 2),
-                        padding="SAME", dtype=self.dtype,
+                        padding="SAME", use_bias=not (normed and i > 0),
+                        dtype=self.dtype,
                         kernel_init=normal_init(), name=f"up{i}",
                     )(y))
             elif self.upsample_mode == "resize":
                 y = UpsampleConvLayer(
-                    f, kernel_size=3, upsample=2, dtype=self.dtype,
+                    f, kernel_size=3, upsample=2,
+                    use_bias=not (normed and i > 0), dtype=self.dtype,
                     name=f"up{i}",
                 )(y)
             else:

@@ -116,6 +116,81 @@ class ConvLayer(nn.Module):
         )(x))
 
 
+def kn2row_thin_conv(x: jax.Array, w: jax.Array, pad: int) -> jax.Array:
+    """Stride-1 conv for THIN outputs (C_out·k² ≪ C_in) as a 1×1 matmul
+    plus shifted slice-adds — the kn2row decomposition.
+
+    A k4 conv from 512 → 1 channel (the PatchGAN head) runs the MXU at
+    3–6 TF/s: one output lane of 128 is live, and XLA's conv kernels
+    re-read the input window-by-window (profiled ~4 ms/step of the
+    256²/bs=128 train step). Rewriting it as
+
+        z[p, t·o] = x[p, :] @ w[t, :, o]        (one 1×1 matmul, one
+                                                 HBM pass over x)
+        y[i, j, o] = Σ_t z_pad[i+dh_t, j+dw_t, t, o]
+
+    moves the only large-tensor traffic into a plain matmul (bandwidth-
+    bound at full HBM rate) and does the k² shift-adds on the tiny tap
+    tensor z (k²·C_out channels). The backward that jax derives is just
+    as lean: dx = dz @ wᵀ (one pass over dx), dw = xᵀ·dz (one re-read of
+    x), slice-transposes on z only.
+
+    x: (N,H,W,C) NHWC; w: (kh,kw,C,O) HWIO; zero padding ``pad`` both
+    sides, stride 1. Returns (N, H+2·pad−kh+1, W+2·pad−kw+1, O).
+    """
+    kh, kw, c, o = w.shape
+    n, h, wd, _ = x.shape
+    ho, wo = h + 2 * pad - kh + 1, wd + 2 * pad - kw + 1
+    wt = w.reshape(kh * kw, c, o).transpose(1, 0, 2).reshape(c, kh * kw * o)
+    # 4-D contraction over the channel dim (NO flattening reshape: a
+    # (-1, C) reshape of e.g. a concat output forces XLA to materialize
+    # layout copies of the big input — profiled +6 ms/step)
+    z = jax.lax.dot_general(
+        x, wt.astype(x.dtype), (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,  # f32 MXU accumulation
+    ).reshape(n, h, wd, kh * kw, o)
+    z = jnp.pad(z, ((0, 0), (pad, pad), (pad, pad), (0, 0), (0, 0)))
+    # f32 accumulation of the k² partial sums: the XLA conv this replaces
+    # accumulates all kh·kw·C terms in f32 and rounds once — matching
+    # that costs nothing (y is the thin output tensor)
+    y = jnp.zeros((n, ho, wo, o), jnp.float32)
+    for t in range(kh * kw):
+        dh, dw = divmod(t, kw)
+        y = y + jax.lax.dynamic_slice(
+            z, (0, dh, dw, t, 0), (n, ho, wo, 1, o)
+        ).reshape(n, ho, wo, o).astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+class KN2RowConv(nn.Module):
+    """Stride-1 thin-output conv module on the kn2row path.
+
+    Param tree ("kernel" HWIO + optional "bias") matches ``nn.Conv`` so
+    checkpoints interchange with the plain path; callers name it
+    ``Conv_0`` to mirror an anonymous inner ``nn.Conv``.
+    """
+
+    features: int
+    kernel_size: int
+    padding: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.kernel_size
+        kernel = self.param("kernel", self.kernel_init,
+                            (k, k, x.shape[-1], self.features), jnp.float32)
+        dt = self.dtype or jnp.float32
+        y = kn2row_thin_conv(x.astype(dt), kernel.astype(dt), self.padding)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(y.dtype)
+        return save_conv_out(y)
+
+
 def upsample_nearest(x: jax.Array, factor: int) -> jax.Array:
     """Nearest-neighbor ×factor upsample in NHWC via broadcast-reshape."""
     if factor == 1:
@@ -163,6 +238,11 @@ class SubpixelDeconv(nn.Module):
 
     features: int
     use_bias: bool = True
+    # kn2row for the inner k2 conv when the output is thin (4F·k² ≪ C):
+    # the image-producing head (F=3 → 12 channels from 128) runs the MXU
+    # at one-tenth lane occupancy as a conv; the kn2row matmul form is a
+    # single full-rate HBM pass over x (see kn2row_thin_conv).
+    thin: bool = False
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
 
@@ -170,12 +250,18 @@ class SubpixelDeconv(nn.Module):
     def __call__(self, x):
         n, h, w, c = x.shape
         f = self.features
-        out = nn.Conv(
-            4 * f, kernel_size=(2, 2), strides=(1, 1),
-            padding=((1, 1), (1, 1)), use_bias=self.use_bias,
-            dtype=self.dtype, kernel_init=self.kernel_init,
-        )(x)                                    # (N, H+1, W+1, 4F)
-        out = save_conv_out(out)
+        if self.thin and 16 * f <= c:
+            out = KN2RowConv(
+                4 * f, kernel_size=2, padding=1, use_bias=self.use_bias,
+                dtype=self.dtype, kernel_init=self.kernel_init,
+                name="Conv_0",
+            )(x)                                # (N, H+1, W+1, 4F)
+        else:
+            out = save_conv_out(nn.Conv(
+                4 * f, kernel_size=(2, 2), strides=(1, 1),
+                padding=((1, 1), (1, 1)), use_bias=self.use_bias,
+                dtype=self.dtype, kernel_init=self.kernel_init,
+            )(x))                               # (N, H+1, W+1, 4F)
         return subpixel_interleave(out, self.features)
 
 

@@ -364,6 +364,10 @@ def main(argv=None) -> int:
                     help="expand = reference recipe (3-scale SN D, "
                          "featmatch+VGG+TV); unet = facades pix2pix recipe "
                          "(70x70 PatchGAN, LSGAN + 100*L1, no VGG term)")
+    ap.add_argument("--grad_clip", type=float, default=0.0,
+                    help="stabilization guard matching the JAX side's "
+                         "--grad_clip: zero non-finite gradient entries, "
+                         "then clip_grad_norm_ to this bound (0 = off)")
     ap.add_argument("--seed", type=int, default=123)
     ap.add_argument("--threads", type=int, default=0)
     ap.add_argument("--out_dir", default="result")
@@ -448,9 +452,20 @@ def main(argv=None) -> int:
                           + 10.0 * vgg_loss(vgg, fake_b, real_b)
                           + tv_loss(fake_b))
 
+            def guard(params):
+                # train/state.py _zero_nonfinite + global-norm clip parity
+                if args.grad_clip > 0:
+                    for p in params:
+                        if p.grad is not None:
+                            torch.nan_to_num_(p.grad, nan=0.0,
+                                              posinf=0.0, neginf=0.0)
+                    torch.nn.utils.clip_grad_norm_(params, args.grad_clip)
+
             opt_g.zero_grad(); loss_g.backward(retain_graph=False)
+            guard(list(g.parameters()))
             opt_g.step()
             opt_d.zero_grad(); loss_d.backward()
+            guard(list(d.parameters()))
             opt_d.step()
             sums["loss_g"] += float(loss_g)
             sums["loss_d"] += float(loss_d)

@@ -330,3 +330,90 @@ def test_remat_wrap_rejects_unknown_mode():
 
     with pytest.raises(ValueError):
         remat_wrap(ResnetBlock, "Conv")
+
+
+def test_dead_bias_removal_forward_exact():
+    """Conv biases in front of mean-subtracting norms are exactly dead:
+    the default (dropped) layout computes the SAME function as the
+    legacy_layout=True layout with its zero-initialized biases, for both
+    BatchNorm (unet) and InstanceNorm (resnet) families."""
+    import flax
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.models.resnet_gen import ResnetGenerator
+    from p2p_tpu.models.unet import UNetGenerator
+
+    x = jnp.asarray(
+        np.random.default_rng(0).uniform(-1, 1, (2, 32, 32, 3)), jnp.float32
+    )
+    for make in (
+        lambda lb: UNetGenerator(ngf=8, legacy_layout=lb),
+        lambda lb: ResnetGenerator(ngf=8, n_blocks=2, legacy_layout=lb),
+    ):
+        new, old = make(False), make(True)
+        vn = new.init(jax.random.PRNGKey(0), x, True)
+        vo = old.init(jax.random.PRNGKey(0), x, True)
+        fo = flax.traverse_util.flatten_dict(vo["params"])
+        fn_keys = flax.traverse_util.flatten_dict(vn["params"]).keys()
+        assert set(fn_keys) < set(fo.keys())  # strictly fewer params
+        shared = flax.traverse_util.unflatten_dict(
+            {k: fo[k] for k in fn_keys})
+        kw = {"mutable": ["batch_stats"]} if "batch_stats" in vn else {}
+        bs = ({"batch_stats": vn["batch_stats"]}
+              if "batch_stats" in vn else {})
+        yn = new.apply({"params": shared, **bs}, x, True, **kw)
+        yo = old.apply(vo, x, True, **kw)
+        if kw:
+            yn, yo = yn[0], yo[0]
+        np.testing.assert_array_equal(np.asarray(yn), np.asarray(yo))
+
+
+def test_unet_thin_head_swap_equivalent_under_weight_mapping():
+    """The up0 image head swap (legacy ConvTranspose k4s2 → kn2row
+    subpixel, models/unet.py) computes the SAME function under the
+    documented weight mapping W'[dh,dw,(u,v)·F] = W[2dh+u,2dw+v] and a
+    per-phase tile of the bias. Uses ngf=32 so 16·out_channels=48 ≤
+    2·ngf=64 actually triggers the swap (the production ngf=64 ratio)."""
+    import flax
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.models.unet import UNetGenerator
+
+    x = jnp.asarray(
+        np.random.default_rng(2).uniform(-1, 1, (2, 64, 64, 3)), jnp.float32
+    )
+    new = UNetGenerator(ngf=32, thin_head=True)
+    old = UNetGenerator(ngf=32, legacy_layout=True)
+    vn = new.init(jax.random.PRNGKey(0), x, True)
+    vo = old.init(jax.random.PRNGKey(0), x, True)
+    fn = flax.traverse_util.flatten_dict(vn["params"])
+    fo = flax.traverse_util.flatten_dict(vo["params"])
+    assert ("up0", "Conv_0", "kernel") in fn          # swap engaged
+    assert ("up0", "kernel") in fo                    # legacy layout
+
+    mapped = {}
+    for k in fn:
+        if k[0] == "up0":
+            continue
+        mapped[k] = fo[k]                             # shared (biases dropped)
+    wt = np.asarray(fo[("up0", "kernel")])            # (4,4,cin,f)
+    cin, f = wt.shape[2], wt.shape[3]
+    w2 = np.zeros((2, 2, 4, cin, f), np.float32)
+    for dh in range(2):
+        for dw in range(2):
+            for u in range(2):
+                for v in range(2):
+                    w2[dh, dw, u * 2 + v] = wt[2 * dh + u, 2 * dw + v]
+    mapped[("up0", "Conv_0", "kernel")] = jnp.asarray(
+        np.moveaxis(w2, 2, 3).reshape(2, 2, cin, 4 * f))
+    mapped[("up0", "Conv_0", "bias")] = jnp.tile(
+        jnp.asarray(fo[("up0", "bias")]), 4)          # same bias every phase
+    params = flax.traverse_util.unflatten_dict(mapped)
+
+    yn, _ = new.apply({"params": params, "batch_stats": vn["batch_stats"]},
+                      x, True, mutable=["batch_stats"])
+    yo, _ = old.apply(vo, x, True, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yn), np.asarray(yo),
+                               rtol=1e-5, atol=1e-5)

@@ -441,3 +441,32 @@ def test_angular_loss_gradient_finite_on_zero_vectors():
     b = jnp.ones((1, 4, 4, 3)) * 0.5
     g = jax.grad(lambda x: angular_loss(b, x))(a)
     assert bool(jnp.isfinite(g).all())
+
+
+def test_kn2row_thin_conv_matches_conv_fwd_and_grad():
+    """kn2row decomposition (ops/conv.py) == XLA conv for thin outputs,
+    forward and both gradients (it is the PatchGAN head's compute path)."""
+    import jax
+
+    from p2p_tpu.ops.conv import kn2row_thin_conv
+
+    rng = np.random.default_rng(0)
+    for (h, w, c, o, pad) in [(17, 17, 64, 1, 2), (10, 14, 32, 2, 1)]:
+        x = jnp.asarray(rng.normal(size=(2, h, w, c)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(4, 4, c, o)), jnp.float32)
+        ref = jax.lax.conv_general_dilated(
+            x, k, (1, 1), ((pad, pad), (pad, pad)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = kn2row_thin_conv(x, k, pad)
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4)
+
+    x = jnp.asarray(rng.normal(size=(2, 12, 12, 32)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(4, 4, 32, 1)), jnp.float32)
+    f1 = lambda x, k: jnp.sum(jnp.sin(kn2row_thin_conv(x, k, 2)))
+    f2 = lambda x, k: jnp.sum(jnp.sin(jax.lax.conv_general_dilated(
+        x, k, (1, 1), ((2, 2), (2, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))))
+    for a, b in zip(jax.grad(f1, (0, 1))(x, k), jax.grad(f2, (0, 1))(x, k)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

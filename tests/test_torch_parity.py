@@ -212,7 +212,10 @@ def test_expand_network_forward_matches_torch_replica():
     rng = np.random.default_rng(4)
     ngf, n_blocks = 8, 2
     x = jnp.asarray(rng.uniform(-1, 1, (1, 16, 16, 3)), jnp.float32)
-    net = ExpandNetwork(ngf=ngf, n_blocks=n_blocks)
+    # legacy_layout: the torch replica mirrors the reference architecture,
+    # whose convs carry biases (the default layout drops the dead ones —
+    # exactness pinned by test_models.py::test_dead_bias_removal...)
+    net = ExpandNetwork(ngf=ngf, n_blocks=n_blocks, legacy_layout=True)
     variables = net.init(jax.random.key(0), x, False)
     y = net.apply(variables, x, False)
 
