@@ -82,6 +82,11 @@ def main() -> None:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8_delayed=True))
         preset = preset + "_ds"
+    if os.environ.get("BENCH_THIN", "") == "1":
+        # U-Net image head as the subpixel form (ModelConfig.thin_head)
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, thin_head=True))
+        preset = preset + "_th"
     if os.environ.get("BENCH_I8DEC", "") == "1":
         # quantized subpixel decoder for the U-Net (QuantSubpixelDeconv)
         cfg = cfg.replace(model=dataclasses.replace(
@@ -128,9 +133,10 @@ def main() -> None:
     baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 pix2pix
     comparable = on_tpu and img == 256 and preset in (
         "facades", "facades_int8", "edges2shoes_dp",
-        # suffix order as generated above: INT8 → DELAYED → I8DEC
+        # suffix order as generated above: INT8 → DELAYED → THIN → I8DEC
         "facades_int8_ds", "facades_int8_i8gd", "facades_int8_i8gd_ds",
         "facades_int8_i8dec", "facades_int8_ds_i8dec",
+        "facades_int8_ds_th",
     )
     dims = f"{img}x{wid}" if wid else f"{img}px"
     record = {

@@ -58,9 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "the absmax reductions from the critical path "
                         "(ops/int8.py int8_conv_ds)")
     p.add_argument("--thin_head", action="store_true", default=None,
-                   help="U-Net image head as the kn2row subpixel form "
-                        "(measured slower on v5e; see "
-                        "ModelConfig.thin_head)")
+                   help="U-Net image head as the subpixel form (k2s1 "
+                        "conv + interleave; measured a wash on v5e, "
+                        "1708 vs 1715 img/s; see ModelConfig.thin_head)")
     p.add_argument("--legacy_layout", action="store_true", default=None,
                    help="keep the dead conv biases in front of norm "
                         "layers (round-2 checkpoint layout; see "

@@ -85,9 +85,11 @@ class ModelConfig:
     # carried in a 'quant' collection threaded through TrainState (like
     # batch_stats), so the forward quantize no longer serializes on an
     # absmax reduction — one HBM pass instead of two per quantized
-    # activation, and the dominant cost at bs=1 (ops/int8.py
-    # int8_conv_ds). Transient clipping after an activation spike decays
-    # in one step (decaying-max update).
+    # activation (ops/int8.py int8_conv_ds). Measured +3% on the bs=128
+    # headline (1632→1681 img/s); a no-op at bs=1 (185.8 vs 186.2 —
+    # that shape is kernel-launch-latency-bound, not absmax-bound,
+    # correcting round 2's hypothesis). Transient clipping after an
+    # activation spike decays in one step (decaying-max update).
     int8_delayed: bool = False
     # Keep the mathematically-dead conv biases in front of mean-
     # subtracting norms (round-2 checkpoint param layout). Default False:
@@ -96,11 +98,13 @@ class ModelConfig:
     # zero-channel-mean cotangents), yet computing those zero gradients
     # re-read full-size cotangents (~3 ms/step at bs=128/256²).
     legacy_layout: bool = False
-    # U-Net image head as the kn2row subpixel form instead of
-    # ConvTranspose. Measured SLOWER on v5e (1538 vs 1681 img/s at
-    # 256²/bs=128 — XLA's fused deconv wins); reachable for other
-    # chips/shapes. Exact weight mapping between the layouts is pinned
-    # in tests/test_models.py.
+    # U-Net image head as the subpixel form (plain k2s1 conv to 4·F
+    # channels + shifted interleave) instead of ConvTranspose. Measured
+    # a wash on v5e at 256²/bs=128 (1708 vs 1715 img/s; the kn2row
+    # variant of the inner conv was distinctly slower, 1538 — see
+    # ops/conv.py SubpixelDeconv.thin). Kept reachable for other
+    # chips/shapes; the exact weight mapping between the layouts is
+    # pinned in tests/test_models.py.
     thin_head: bool = False
 
 

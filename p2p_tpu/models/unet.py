@@ -66,11 +66,11 @@ class UNetGenerator(nn.Module):
     # function, same training dynamics — they initialize at 0 and never
     # move). True restores the round-2 checkpoint param layout.
     legacy_layout: bool = False
-    # Image head as kn2row subpixel instead of ConvTranspose. Measured
-    # SLOWER on v5e at 256²/bs=128 (1538 vs 1681 img/s: XLA's fused
-    # deconv beats the extra z-tensor round-trip); kept as an option for
-    # other chips/shapes. tests/test_models.py pins the exact weight
-    # mapping between the two layouts.
+    # Image head as the subpixel form (plain k2s1 conv + interleave)
+    # instead of ConvTranspose. Measured a wash on v5e at 256²/bs=128
+    # (1708 vs 1715 img/s; the kn2row inner-conv variant was slower,
+    # 1538). Kept as an option for other chips/shapes;
+    # tests/test_models.py pins the exact weight mapping.
     thin_head: bool = False
     dtype: Optional[jnp.dtype] = None
 
@@ -152,10 +152,14 @@ class UNetGenerator(nn.Module):
                     )(y)
                 elif (i == 0 and self.thin_head
                       and not self.legacy_layout and 16 * f <= y.shape[-1]):
-                    # image head as the kn2row subpixel form (see
-                    # thin_head doc — off by default on v5e)
+                    # image head as the subpixel form (see thin_head doc).
+                    # Plain k2s1 conv, NOT the kn2row variant: the dense
+                    # 128→4F conv reads x once at full HBM rate and its
+                    # backward is a regular conv backward (no deconv
+                    # `reverse` kernels); kn2row's z round-trip measured
+                    # slower here (1538).
                     y = SubpixelDeconv(
-                        f, thin=True, dtype=self.dtype,
+                        f, dtype=self.dtype,
                         kernel_init=normal_init(), name=f"up{i}",
                     )(y)
                 else:

@@ -238,10 +238,11 @@ class SubpixelDeconv(nn.Module):
 
     features: int
     use_bias: bool = True
-    # kn2row for the inner k2 conv when the output is thin (4F·k² ≪ C):
-    # the image-producing head (F=3 → 12 channels from 128) runs the MXU
-    # at one-tenth lane occupancy as a conv; the kn2row matmul form is a
-    # single full-rate HBM pass over x (see kn2row_thin_conv).
+    # kn2row for the inner k2 conv (see kn2row_thin_conv). Measured
+    # SLOWER than the plain conv on v5e as the U-Net image head (1538
+    # vs 1708 img/s at 256²/bs=128 — the z-tensor round-trip loses);
+    # kept as an op-level variant for thin-output experiments, pinned
+    # equivalent to the plain path in tests/test_ops.py.
     thin: bool = False
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()

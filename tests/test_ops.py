@@ -470,3 +470,22 @@ def test_kn2row_thin_conv_matches_conv_fwd_and_grad():
         dimension_numbers=("NHWC", "HWIO", "NHWC"))))
     for a, b in zip(jax.grad(f1, (0, 1))(x, k), jax.grad(f2, (0, 1))(x, k)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_subpixel_deconv_thin_variant_matches_plain():
+    """SubpixelDeconv(thin=True) — the kn2row inner conv — computes the
+    same function as the plain-conv path from the same params (kept as
+    an op-level variant; measured slower on v5e as the image head)."""
+    import jax
+
+    from p2p_tpu.ops.conv import SubpixelDeconv
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 64)), jnp.float32)
+    plain, thin = SubpixelDeconv(3), SubpixelDeconv(3, thin=True)
+    v = plain.init(jax.random.key(0), x)
+    v2 = thin.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(v2)
+    np.testing.assert_allclose(
+        np.asarray(thin.apply(v, x)), np.asarray(plain.apply(v, x)),
+        rtol=1e-5, atol=1e-5)
