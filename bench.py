@@ -41,12 +41,13 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    # Default headline: the int8-discriminator QAT step — identical
-    # architecture/losses to 'facades' (the bf16 number is one
-    # BENCH_PRESET=facades away); trained-quality parity of the int8
-    # path is evidenced on real photos in metrics_facades_int8.jsonl /
-    # README "Trained-quality check" (final 24.58 dB / 0.79 SSIM /
-    # 0.41 VFID vs bf16's 23.85 / 0.71 / 0.38).
+    # Default headline: the int8-discriminator QAT step with DELAYED
+    # (stored-scale) activation quantization — identical architecture/
+    # losses to 'facades' (the bf16 number is one BENCH_PRESET=facades
+    # away); trained-quality evidence for THIS path is the decayed
+    # 40-epoch real-photo run metrics_facades_int8_decay.jsonl (README
+    # "Round 3": final 22.21 dB / 0.769 SSIM / 0.63 VFID, best-in-decay
+    # 23.75 / 0.794 / 0.398 — at the dynamic-path peak level).
     preset = os.environ.get("BENCH_PRESET", "facades_int8")
     cfg = get_preset(preset)
     facades_like = preset in ("facades", "facades_int8")
@@ -77,8 +78,10 @@ def main() -> None:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8=True, int8_generator=both))
         preset = preset + ("_i8gd" if both else "_i8d")
-    if os.environ.get("BENCH_DELAYED", "") == "1":
+    if (os.environ.get("BENCH_DELAYED", "") == "1"
+            and not cfg.model.int8_delayed):
         # delayed (stored-scale) activation quantization, ops/int8.py
+        # (no-op suffix-skip when the preset already ships delayed)
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8_delayed=True))
         preset = preset + "_ds"
@@ -94,8 +97,14 @@ def main() -> None:
         preset = preset + "_i8dec"
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
-    host = synthetic_batch(batch_size=bs, size=img, bits=cfg.model.quant_bits,
-                           width=wid)
+    n_frames = cfg.data.n_frames
+    host = synthetic_batch(batch_size=bs * max(n_frames, 1), size=img,
+                           bits=cfg.model.quant_bits, width=wid)
+    if n_frames > 1:
+        # video presets: NTHWC clips through the video step (the img/s
+        # figure counts FRAMES — the per-chip pixel-throughput analogue)
+        host = {k: v.reshape(bs, n_frames, *v.shape[1:])
+                for k, v in host.items()}
     single = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
     batches = {
         k: jnp.asarray(np.broadcast_to(v, (scan_k,) + v.shape).copy(),
@@ -103,14 +112,25 @@ def main() -> None:
         for k, v in host.items()
     }
 
-    state = create_train_state(cfg, jax.random.key(0), single,
-                               train_dtype=dtype)
     vgg_params = None
     if cfg.loss.lambda_vgg > 0:
         vgg_params = load_vgg19_params(
             jnp.bfloat16 if dtype is not None else jnp.float32
         )
-    step = build_multi_train_step(cfg, vgg_params, train_dtype=dtype)
+    if n_frames > 1:
+        from p2p_tpu.train.video_step import (
+            build_multi_video_train_step,
+            create_video_train_state,
+        )
+
+        state = create_video_train_state(cfg, jax.random.key(0), single,
+                                         train_dtype=dtype)
+        step = build_multi_video_train_step(cfg, vgg_params,
+                                            train_dtype=dtype)
+    else:
+        state = create_train_state(cfg, jax.random.key(0), single,
+                                   train_dtype=dtype)
+        step = build_multi_train_step(cfg, vgg_params, train_dtype=dtype)
 
     # tunnel round-trip cost of one trivial fetch
     trivial = jax.jit(lambda v: v + 1)
@@ -129,7 +149,7 @@ def main() -> None:
     float(metrics["loss_g"][-1])  # forces the whole chained sequence
     elapsed = max(time.perf_counter() - t0 - rtt, 1e-9)
 
-    img_per_sec = bs * scan_k * n_calls / elapsed
+    img_per_sec = bs * max(n_frames, 1) * scan_k * n_calls / elapsed
     baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 pix2pix
     comparable = on_tpu and img == 256 and preset in (
         "facades", "facades_int8", "edges2shoes_dp",

@@ -52,11 +52,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--int8_generator", action="store_true", default=None,
                    help="extend --int8 to the generator convs (measured "
                         "slower on v5e at 256^2; see ModelConfig)")
-    p.add_argument("--int8_delayed", action="store_true", default=None,
+    p.add_argument("--int8_delayed", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="delayed (stored-scale) activation quantization: "
                         "per-layer amax carried in TrainState; removes "
                         "the absmax reductions from the critical path "
-                        "(ops/int8.py int8_conv_ds)")
+                        "(ops/int8.py int8_conv_ds). --no-int8_delayed "
+                        "restores the dynamic-scale path (required to "
+                        "RESUME pre-round-3 facades_int8 checkpoints — "
+                        "the quant collection changes the TrainState "
+                        "tree)")
     p.add_argument("--thin_head", action="store_true", default=None,
                    help="U-Net image head as the subpixel form (k2s1 "
                         "conv + interleave; measured a wash on v5e, "

@@ -264,14 +264,16 @@ _register(
 
 # facades on the int8 QAT MXU path (ops/int8.py): identical architecture
 # and losses; the DISCRIMINATOR's inner convs run s8×s8→s32 on the MXU
-# (2× peak on v5e) with dynamic symmetric scales — the generator stays
-# bf16 (int8_generator measured slower at this shape), stems/heads bf16.
+# (2× peak on v5e) with DELAYED (stored-scale) activation quantization —
+# the round-3 headline path, trained to quality over 40 epochs on real
+# photos (metrics_facades_int8_decay.jsonl). The generator stays bf16
+# (int8_generator measured slower at this shape), stems/heads bf16.
 _register(
     Config(
         name="facades_int8",
         model=ModelConfig(generator="unet", ngf=64, num_D=1, n_layers_D=3,
                           use_spectral_norm=False, use_compression_net=False,
-                          use_dropout=True, int8=True),
+                          use_dropout=True, int8=True, int8_delayed=True),
         loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
                         lambda_l1=100.0),
         data=DataConfig(dataset="facades", image_size=256, batch_size=1),

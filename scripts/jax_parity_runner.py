@@ -45,6 +45,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=123)
     ap.add_argument("--out_dir", default="result")
     ap.add_argument("--scan_steps", type=int, default=8)
+    ap.add_argument("--grad_clip", type=float, default=0.0,
+                    help="stabilization guard (train/state.py: zero "
+                         "non-finite entries + global-norm clip); matches "
+                         "the torch runner's --grad_clip")
     args = ap.parse_args(argv)
 
     import jax
@@ -71,6 +75,7 @@ def main(argv=None) -> int:
             image_size=args.size,
         ),
         train=dataclasses.replace(cfg.train, seed=args.seed),
+        optim=dataclasses.replace(cfg.optim, grad_clip=args.grad_clip),
     )
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
