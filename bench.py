@@ -90,6 +90,11 @@ def main() -> None:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, thin_head=True))
         preset = preset + "_th"
+    if os.environ.get("BENCH_HPAL", "") == "1":
+        # thin head through the Pallas fused kernel
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, thin_head=True, head_pallas=True))
+        preset = preset.removesuffix("_th") + "_hp"
     if os.environ.get("BENCH_I8DEC", "") == "1":
         # quantized subpixel decoder for the U-Net (QuantSubpixelDeconv)
         cfg = cfg.replace(model=dataclasses.replace(
@@ -156,7 +161,7 @@ def main() -> None:
         # suffix order as generated above: INT8 → DELAYED → THIN → I8DEC
         "facades_int8_ds", "facades_int8_i8gd", "facades_int8_i8gd_ds",
         "facades_int8_i8dec", "facades_int8_ds_i8dec",
-        "facades_int8_ds_th",
+        "facades_int8_ds_th", "facades_int8_th", "facades_int8_hp",
     )
     dims = f"{img}x{wid}" if wid else f"{img}px"
     record = {

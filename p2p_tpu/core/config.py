@@ -106,6 +106,10 @@ class ModelConfig:
     # chips/shapes; the exact weight mapping between the layouts is
     # pinned in tests/test_models.py.
     thin_head: bool = False
+    # With thin_head: run the head's k2 conv through the Pallas fused
+    # kernel (ops/pallas/subpixel_head.py — x read once per sample
+    # block, tap matmuls accumulated in VMEM) instead of the XLA conv.
+    head_pallas: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -52,6 +52,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             int8_delayed=delayed,
             legacy_layout=cfg.legacy_layout,
             thin_head=cfg.thin_head,
+            head_pallas=cfg.head_pallas,
             dtype=dtype,
         )
     if cfg.generator == "resnet":

@@ -72,6 +72,8 @@ class UNetGenerator(nn.Module):
     # 1538). Kept as an option for other chips/shapes;
     # tests/test_models.py pins the exact weight mapping.
     thin_head: bool = False
+    # with thin_head: Pallas fused kernel for the head's k2 conv
+    head_pallas: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -91,6 +93,10 @@ class UNetGenerator(nn.Module):
                         pow2_levels(x.shape[2]))
 
         normed = self.norm != "none" and not self.legacy_layout
+        if self.head_pallas and (not self.thin_head or self.legacy_layout):
+            raise ValueError(
+                "head_pallas requires thin_head (the subpixel head form) "
+                "and the default (non-legacy) layout")
 
         def down_conv(y, features, name, int8=False, norm_after=False):
             bias = not norm_after
@@ -159,7 +165,7 @@ class UNetGenerator(nn.Module):
                     # `reverse` kernels); kn2row's z round-trip measured
                     # slower here (1538).
                     y = SubpixelDeconv(
-                        f, dtype=self.dtype,
+                        f, pallas=self.head_pallas, dtype=self.dtype,
                         kernel_init=normal_init(), name=f"up{i}",
                     )(y)
                 else:

@@ -217,6 +217,38 @@ def subpixel_interleave(out: jax.Array, features: int) -> jax.Array:
     return y.reshape(n, 2 * h, 2 * w, f)
 
 
+class _PallasHeadConv(nn.Module):
+    """k2-s1 pad-1 conv via the Pallas subpixel-head kernel; param tree
+    ("kernel" HWIO (2,2,C,F) + optional "bias") matches ``nn.Conv``."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+
+    @nn.compact
+    def __call__(self, x):
+        from p2p_tpu.ops.pallas.subpixel_head import subpixel_head_conv
+
+        kernel = self.param("kernel", self.kernel_init,
+                            (2, 2, x.shape[-1], self.features), jnp.float32)
+        dt = self.dtype or jnp.float32
+        interpret = jax.devices()[0].platform != "tpu"
+        if not interpret:
+            # current Mosaic rejects the kernel's layout folds at odd
+            # spatial extents — see ops/pallas/subpixel_head.py STATUS
+            raise NotImplementedError(
+                "SubpixelDeconv(pallas=True) is interpret-mode only on "
+                "this TPU runtime (Mosaic 'unsupported shape cast'); "
+                "use the default XLA head")
+        y = subpixel_head_conv(x.astype(dt), kernel.astype(dt), interpret)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias
+        return save_conv_out(y.astype(dt))
+
+
 class SubpixelDeconv(nn.Module):
     """ConvTranspose(k4, s2, 'SAME') re-expressed as conv(k2, s1) + shifted
     depth-to-space — the TPU-friendly learned 2× upsample.
@@ -244,6 +276,10 @@ class SubpixelDeconv(nn.Module):
     # kept as an op-level variant for thin-output experiments, pinned
     # equivalent to the plain path in tests/test_ops.py.
     thin: bool = False
+    # Pallas fused path for the inner k2 conv: the 4 tap matmuls
+    # accumulate in VMEM, x is read once per sample block
+    # (ops/pallas/subpixel_head.py). Param tree unchanged (Conv_0).
+    pallas: bool = False
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
 
@@ -251,7 +287,12 @@ class SubpixelDeconv(nn.Module):
     def __call__(self, x):
         n, h, w, c = x.shape
         f = self.features
-        if self.thin and 16 * f <= c:
+        if self.pallas:
+            out = _PallasHeadConv(
+                4 * f, use_bias=self.use_bias, dtype=self.dtype,
+                kernel_init=self.kernel_init, name="Conv_0",
+            )(x)                                # (N, H+1, W+1, 4F)
+        elif self.thin and 16 * f <= c:
             out = KN2RowConv(
                 4 * f, kernel_size=2, padding=1, use_bias=self.use_bias,
                 dtype=self.dtype, kernel_init=self.kernel_init,

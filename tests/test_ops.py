@@ -489,3 +489,42 @@ def test_subpixel_deconv_thin_variant_matches_plain():
     np.testing.assert_allclose(
         np.asarray(thin.apply(v, x)), np.asarray(plain.apply(v, x)),
         rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_subpixel_head_matches_xla_fwd_and_grad():
+    """ops/pallas/subpixel_head.py (interpret mode) == the XLA k2-s1 conv
+    it replaces, forward and both gradients, and the SubpixelDeconv
+    pallas=True module path shares the plain path's param tree."""
+    import jax
+
+    if jax.devices()[0].platform == "tpu":  # conftest pins tests to CPU;
+        pytest.skip("module path is interpret-only (Mosaic gate)")
+
+    from p2p_tpu.ops.conv import SubpixelDeconv
+    from p2p_tpu.ops.pallas.subpixel_head import subpixel_head_conv
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 12, 10, 32)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 2, 32, 12)), jnp.float32) * 0.1
+
+    def xla_ref(x, k):
+        return jax.lax.conv_general_dilated(
+            x, k, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    np.testing.assert_allclose(
+        np.asarray(subpixel_head_conv(x, k, True)),
+        np.asarray(xla_ref(x, k)), atol=1e-4)
+    f1 = lambda x, k: jnp.sum(jnp.sin(subpixel_head_conv(x, k, True)))
+    f2 = lambda x, k: jnp.sum(jnp.sin(xla_ref(x, k)))
+    for a, b in zip(jax.grad(f1, (0, 1))(x, k), jax.grad(f2, (0, 1))(x, k)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    xm = jnp.asarray(rng.normal(size=(2, 8, 8, 64)), jnp.float32)
+    plain, pls = SubpixelDeconv(3), SubpixelDeconv(3, pallas=True)
+    v = plain.init(jax.random.key(0), xm)
+    assert (jax.tree_util.tree_structure(v)
+            == jax.tree_util.tree_structure(pls.init(jax.random.key(1), xm)))
+    np.testing.assert_allclose(
+        np.asarray(pls.apply(v, xm)), np.asarray(plain.apply(v, xm)),
+        rtol=1e-5, atol=1e-5)
