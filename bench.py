@@ -91,10 +91,17 @@ def main() -> None:
             cfg.model, thin_head=True))
         preset = preset + "_th"
     if os.environ.get("BENCH_HPAL", "") == "1":
-        # thin head through the Pallas fused kernel
+        # thin head through the Pallas fused kernel (bypass the Mosaic
+        # gate so runtime upgrades get re-probed — ops/conv.py)
+        os.environ["P2P_HPAL_FORCE"] = "1"
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, thin_head=True, head_pallas=True))
         preset = preset.removesuffix("_th") + "_hp"
+    if os.environ.get("BENCH_UPSAMPLE", ""):
+        # override the U-Net decoder upsample family (deconv|subpixel|resize)
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, upsample_mode=os.environ["BENCH_UPSAMPLE"]))
+        preset = preset + "_" + os.environ["BENCH_UPSAMPLE"]
     if os.environ.get("BENCH_I8DEC", "") == "1":
         # quantized subpixel decoder for the U-Net (QuantSubpixelDeconv)
         cfg = cfg.replace(model=dataclasses.replace(

@@ -184,9 +184,14 @@ def make_loader(
                     rng.shuffle(idx)
                 # per-process record sharding, mirroring ShardByJaxProcess —
                 # the multi-process assembly path must never feed
-                # duplicated samples
+                # duplicated samples. With drop_remainder the shards must
+                # also be EQUAL-SIZED (Grain's drop_remainder semantics):
+                # an uneven split would hand one process an extra batch
+                # whose collectives the others never join — deadlock.
                 n_proc = jax.process_count()
                 if n_proc > 1:
+                    if drop_remainder:
+                        idx = idx[: len(idx) - len(idx) % n_proc]
                     idx = idx[jax.process_index()::n_proc]
                 yield from _Stacked(dataset, batch_size, list(idx),
                                     drop_remainder)

@@ -47,6 +47,54 @@ def avg_pool_downsample(x: jax.Array) -> jax.Array:
     return sum_ / cnt
 
 
+class _SplitStemConv(nn.Module):
+    """The conditional-D stem conv applied to an UNCONCATENATED (a, b)
+    pair: ``conv(concat(a,b), W) == conv(a, W[:,:,:ca]) + conv(b, W[:,:,ca:])``
+    by linearity of convolution in the input channels.
+
+    Why: the reference concatenates (input ‖ output) before D
+    (train.py:308,315) and so did round 3 — materializing two 6-channel
+    NHWC pairs per step (~100 MB each at 256²/bs128) that the stem
+    immediately re-reads, and computing the conditioning half
+    ``conv(real_a, W_a)`` twice (fake and real branches — XLA CSE dedupes
+    the identical subexpression once the halves are separate ops). The
+    fake branch's input cotangent also becomes per-half, so the dead
+    ``real_a`` dgrad disappears structurally instead of being sliced off
+    after computation (train/step.py round-3 ``[..., in_c:]``).
+
+    Param tree matches the concat path exactly (``Conv_0/{kernel,bias}``
+    with the full 6-channel HWIO kernel) — checkpoints interchange, and
+    init still runs the concat path.
+    """
+
+    features: int
+    stride: int
+    padding: int = 2
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, a, b):
+        c = a.shape[-1] + b.shape[-1]
+        kernel = self.param("kernel", normal_init(),
+                            (4, 4, c, self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        dt = self.dtype or jnp.float32
+        ca = a.shape[-1]
+        pad = [(self.padding, self.padding)] * 2
+
+        def cv(inp, kk):
+            dn = jax.lax.conv_dimension_numbers(
+                inp.shape, kk.shape, ("NHWC", "HWIO", "NHWC"))
+            return jax.lax.conv_general_dilated(
+                inp.astype(dt), kk.astype(dt),
+                (self.stride, self.stride), pad, dimension_numbers=dn,
+            )
+
+        y = cv(a, kernel[:, :, :ca]) + cv(b, kernel[:, :, ca:])
+        return save_conv_out(y + bias.astype(y.dtype))
+
+
 class _PlainConv(nn.Module):
     features: int
     stride: int
@@ -59,6 +107,15 @@ class _PlainConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        if isinstance(x, (tuple, list)):
+            # unconcatenated conditional pair — the split-stem path
+            # (param tree identical to the concat path: Conv_0 holds the
+            # full 6-channel kernel)
+            a, b = x
+            return _SplitStemConv(
+                self.features, stride=self.stride, padding=self.padding,
+                dtype=self.dtype, name="Conv_0",
+            )(a, b)
         if self.stride == 1 and self.features * 16 <= x.shape[-1]:
             # thin head (e.g. 512→1): kn2row matmul decomposition — the
             # MXU conv runs at 3-6 TF/s with one live output lane; this
@@ -170,5 +227,11 @@ class MultiscaleDiscriminator(nn.Module):
             )
             results.append(d(current))
             if i != self.num_D - 1:
-                current = avg_pool_downsample(current)
+                # unconcatenated (a, b) pairs downsample elementwise —
+                # AvgPool is channelwise, so pooling the halves equals
+                # pooling the concat
+                if isinstance(current, (tuple, list)):
+                    current = tuple(avg_pool_downsample(t) for t in current)
+                else:
+                    current = avg_pool_downsample(current)
         return results

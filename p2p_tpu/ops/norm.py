@@ -32,6 +32,42 @@ def _gamma_init(key, shape, dtype=jnp.float32):
     return 1.0 + jax.random.normal(key, shape, dtype) * 0.02
 
 
+@jax.custom_vjp
+def dual_moments(xc):
+    """Per-channel (Σxc, Σxc²) over all leading axes in ONE variadic
+    reduction — f32 accumulation.
+
+    Two separate ``jnp.mean`` reductions profile as one fused kernel that
+    still READS the activation twice (534 MB moved for a 268 MB tensor —
+    the round-3 BatchNorm_12 'add' kernel). A variadic ``lax.reduce`` with
+    the square fused as an elementwise producer is a single pass. The VJP
+    is the same closed form XLA derives for sum/sumsq:
+    ``dxc = ds + 2·xc·dss`` (broadcast over channels).
+    """
+    xf = xc.astype(jnp.float32)
+    dims = tuple(range(xc.ndim - 1))
+    return jax.lax.reduce(
+        (xf, jnp.square(xf)),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        dims,
+    )
+
+
+def _dual_moments_fwd(xc):
+    out = dual_moments(xc)
+    return out, xc
+
+
+def _dual_moments_bwd(xc, ct):
+    ds, dss = ct
+    dxc = ds.astype(jnp.float32) + 2.0 * xc.astype(jnp.float32) * dss
+    return (dxc.astype(xc.dtype),)
+
+
+dual_moments.defvjp(_dual_moments_fwd, _dual_moments_bwd)
+
+
 class _FastBatchNorm(nn.Module):
     """Hand-written BatchNorm tuned for TPU HBM traffic.
 
@@ -80,11 +116,10 @@ class _FastBatchNorm(nn.Module):
             # Still a single read of x — the shift fuses into the reduces.
             c = jax.lax.stop_gradient(ra_mean.value).astype(x.dtype)
             xc = x - c
-            mean_c = jnp.mean(xc, axis=reduce_axes, dtype=jnp.float32)
-            msq_c = jnp.mean(
-                jnp.square(xc.astype(jnp.float32)), axis=reduce_axes,
-                dtype=jnp.float32,
-            )
+            n = x.size // x.shape[-1]
+            sum_c, sumsq_c = dual_moments(xc)
+            mean_c = sum_c / n
+            msq_c = sumsq_c / n
             if self.axis_name is not None:
                 mean_c = jax.lax.pmean(mean_c, self.axis_name)
                 msq_c = jax.lax.pmean(msq_c, self.axis_name)

@@ -106,6 +106,21 @@ class Trainer:
 
             enable_nan_debugging()
 
+        if cfg.train.eval_fid and jax.process_count() > 1:
+            # FIDEvaluator accumulates host-side numpy features; a global
+            # array's rows are only partially addressable per process.
+            # Per-process FID over a shard would be a DIFFERENT statistic
+            # (means/covariances of half the set), so disable rather than
+            # silently report a wrong number. (Before the VGG load below —
+            # eval_fid alone must not pull the weights onto every host.)
+            print("WARNING: eval_fid disabled on multi-process runs "
+                  "(host-side feature accumulation is per-process).",
+                  flush=True)
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, train=dataclasses.replace(cfg.train, eval_fid=False))
+            self.cfg = cfg
         self.vgg_params = (
             load_vgg19_params()
             if (cfg.loss.lambda_vgg > 0 or cfg.loss.lambda_style > 0
@@ -134,35 +149,15 @@ class Trainer:
             cfg, jax.random.key(cfg.train.seed), sample,
             self.steps_per_epoch, dtype,
         )
+        if self.mesh is not None and self.mesh.size > 1:
+            # Replicate the state over the mesh (as VideoTrainer does):
+            # batches arrive committed to all mesh devices, and jit
+            # refuses to mix them with single-device state arrays.
+            from p2p_tpu.core.mesh import replicated
 
-        def with_mesh(fn):
-            # Tracing happens inside the first CALL of a jitted fn, so
-            # wrapping the call in mesh_context makes the mesh visible to
-            # trace-time dispatch — the sharded Pallas InstanceNorm reads
-            # it to wrap itself in shard_map; without this the spatial>1
-            # CLI path would all-gather activations around the custom call.
-            if self.mesh is None:
-                return fn
-
-            from p2p_tpu.core.mesh import mesh_context
-
-            def wrapped(*a, **kw):
-                with mesh_context(self.mesh):
-                    return fn(*a, **kw)
-
-            return wrapped
-
-        self.train_step = with_mesh(build_train_step(
-            cfg, self.vgg_params, self.steps_per_epoch, dtype
-        ))
-        self.multi_step = None
-        if cfg.train.scan_steps > 1:
-            from p2p_tpu.train.step import build_multi_train_step
-
-            self.multi_step = with_mesh(build_multi_train_step(
-                cfg, self.vgg_params, self.steps_per_epoch, dtype
-            ))
-        self.eval_step = with_mesh(build_eval_step(cfg, dtype))
+            self.state = jax.device_put(self.state, replicated(self.mesh))
+        self._dtype = dtype
+        self._build_step_fns()
         ckpt_dir = os.path.join(
             workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
         )
@@ -176,6 +171,61 @@ class Trainer:
         )
         self.epoch = cfg.train.epoch_count
 
+    def _with_mesh(self, fn):
+        # Tracing happens inside the first CALL of a jitted fn, so
+        # wrapping the call in mesh_context makes the mesh visible to
+        # trace-time dispatch — the sharded Pallas InstanceNorm reads
+        # it to wrap itself in shard_map; without this the spatial>1
+        # CLI path would all-gather activations around the custom call.
+        if self.mesh is None:
+            return fn
+
+        from p2p_tpu.core.mesh import mesh_context
+
+        def wrapped(*a, **kw):
+            with mesh_context(self.mesh):
+                return fn(*a, **kw)
+
+        return wrapped
+
+    def _build_step_fns(self) -> None:
+        cfg = self.cfg
+        self.train_step = self._with_mesh(build_train_step(
+            cfg, self.vgg_params, self.steps_per_epoch, self._dtype
+        ))
+        self.multi_step = None
+        if cfg.train.scan_steps > 1:
+            from p2p_tpu.train.step import build_multi_train_step
+
+            self.multi_step = self._with_mesh(build_multi_train_step(
+                cfg, self.vgg_params, self.steps_per_epoch, self._dtype
+            ))
+        self.eval_step = self._with_mesh(build_eval_step(cfg, self._dtype))
+        # Sample-dump-only helper: the reference saves the QUANTIZED
+        # compressed intermediate next to input/target/pred each epoch
+        # (train.py:469-473) — the one image showing what the compression
+        # net does. Separate tiny jit (not part of eval_step) so the eval
+        # loop pays nothing; runs once per eval, first batch only.
+        self.comp_fn = None
+        if cfg.model.use_compression_net:
+            from p2p_tpu.ops.quantize import quantize
+            from p2p_tpu.train.state import build_models
+
+            _, _, c = build_models(cfg, self._dtype)
+            bits = cfg.model.quant_bits
+
+            def comp_fn(state, target):
+                if self._dtype is not None:
+                    target = target.astype(self._dtype)
+                raw = c.apply(
+                    {"params": state.params_c,
+                     "batch_stats": state.batch_stats_c},
+                    target, False,
+                )
+                return quantize(raw, bits)
+
+            self.comp_fn = self._with_mesh(jax.jit(comp_fn))
+
     def _host_batch_sample(self):
         item = self.train_ds[0]
         bs = self.cfg.data.batch_size
@@ -188,7 +238,31 @@ class Trainer:
         if step is None:
             return False
         self.state = self.ckpt.restore(self.state)
-        self.epoch = 1 + int(step) // self.steps_per_epoch
+        done = int(step) // self.steps_per_epoch
+        # --epoch_count N means "continue labeling at epoch N" (reference
+        # train.py:137,253-255); without it the restored step names the
+        # epoch.
+        self.epoch = max(self.cfg.train.epoch_count, 1 + done)
+        # The restored optimizer step already encodes `done` epochs, so
+        # the schedule's compiled-in offset must be the flag MINUS those:
+        # keeping the full --epoch_count would count them twice — e.g.
+        # --epoch_count 21 --niter 20 --niter_decay 10 after 20 epochs
+        # gives mult = 1 - (20 + 21 - 20)/11 < 0 → clamped to 0, and the
+        # continuation trains at LR=0 (observed on the round-3 hd_r3
+        # resume: bitwise-identical evals). The subtraction also keeps a
+        # warm-start labeling (a run STARTED fresh at epoch_count > 1,
+        # whose step counter never encoded the offset) on its original
+        # curve. Rebuilding is recompile-free — jit traces at first call,
+        # which hasn't happened yet.
+        eff = max(1, self.cfg.train.epoch_count - done)
+        if eff != self.cfg.train.epoch_count:
+            import dataclasses
+
+            self.cfg = dataclasses.replace(
+                self.cfg,
+                train=dataclasses.replace(self.cfg.train, epoch_count=eff),
+            )
+            self._build_step_fns()
         if self.plateau is not None:
             # lr_scale only ever decreases; seed the fresh controller from
             # the restored state so resume doesn't undo prior reductions.
@@ -340,6 +414,22 @@ class Trainer:
         # scored) must still split over the mesh's data axis — pad by
         # edge-repeat, then trim the per-image metric vectors.
         shards = int(self.mesh.shape["data"]) if self.mesh is not None else 1
+        n_proc = jax.process_count()
+
+        def metric_local(vec):
+            """Process-local entries of a per-image metric vector. On one
+            process the global array is fully addressable; on >1 only this
+            process's rows are — np.asarray would raise — so gather the
+            addressable shards in row order (this process's own images,
+            because the loader fed exactly those rows of the global batch)."""
+            if n_proc == 1:
+                return np.asarray(vec).ravel()
+            parts = sorted(
+                vec.addressable_shards,
+                key=lambda s: s.index[0].start or 0,
+            )
+            return np.concatenate(
+                [np.asarray(p.data).ravel() for p in parts])
 
         def padded(it):
             for b in it:
@@ -361,38 +451,83 @@ class Trainer:
                 fid_eval.update(batch["target"][:n_real], pred[:n_real])
             # per-image vectors → the max below is over individual images,
             # matching the reference report (train.py:498-502)
-            psnrs.extend(np.asarray(metrics["psnr"]).ravel()[:n_real].tolist())
-            ssims.extend(np.asarray(metrics["ssim"]).ravel()[:n_real].tolist())
+            psnrs.extend(metric_local(metrics["psnr"])[:n_real].tolist())
+            ssims.extend(metric_local(metrics["ssim"])[:n_real].tolist())
             if save_samples and not sample_saved:
-                out_dir = os.path.join(
-                    self.workdir, cfg.train.result_dir, cfg.data.dataset
-                )
-                os.makedirs(out_dir, exist_ok=True)
-                save_img(np.asarray(batch["input"])[0],
-                         os.path.join(out_dir, f"e{self.epoch}_input.png"))
-                save_img(np.asarray(batch["target"])[0],
-                         os.path.join(out_dir, f"e{self.epoch}_target.png"))
-                save_img(np.asarray(pred)[0].astype(np.float32),
-                         os.path.join(out_dir, f"e{self.epoch}_pred.png"))
-                if cfg.train.save_masks:
-                    # the reference's commented masking experiment
-                    # (train.py:329-334): bitwise-AND of the uint8 images
-                    from p2p_tpu.utils.images import to_uint8_img
+                # comp is an SPMD computation over a (possibly) global
+                # array: EVERY process must execute it — only the file
+                # writes below are process-0-only.
+                comp = (self.comp_fn(self.state, batch["target"])
+                        if self.comp_fn is not None else None)
 
-                    mask = np.bitwise_and(
-                        to_uint8_img(np.asarray(pred)[0].astype(np.float32)),
-                        to_uint8_img(np.asarray(batch["input"])[0]),
+                def first_img(arr):
+                    # first locally-addressable image (global arrays are
+                    # only partially addressable on >1 process)
+                    if n_proc > 1:
+                        arr = arr.addressable_shards[0].data
+                    return np.asarray(arr)[0].astype(np.float32)
+
+                if jax.process_index() == 0:
+                    out_dir = os.path.join(
+                        self.workdir, cfg.train.result_dir, cfg.data.dataset
                     )
-                    save_img(mask, os.path.join(
-                        out_dir, f"e{self.epoch}_mask.png"))
+                    os.makedirs(out_dir, exist_ok=True)
+                    save_img(first_img(batch["input"]),
+                             os.path.join(out_dir, f"e{self.epoch}_input.png"))
+                    save_img(first_img(batch["target"]),
+                             os.path.join(out_dir, f"e{self.epoch}_target.png"))
+                    save_img(first_img(pred),
+                             os.path.join(out_dir, f"e{self.epoch}_pred.png"))
+                    if comp is not None:
+                        save_img(first_img(comp),
+                                 os.path.join(out_dir, f"e{self.epoch}_comp.png"))
+                    if cfg.train.save_masks:
+                        # the reference's commented masking experiment
+                        # (train.py:329-334): bitwise-AND of the uint8 images
+                        from p2p_tpu.utils.images import to_uint8_img
+
+                        mask = np.bitwise_and(
+                            to_uint8_img(first_img(pred)),
+                            to_uint8_img(first_img(batch["input"])),
+                        )
+                        save_img(mask, os.path.join(
+                            out_dir, f"e{self.epoch}_mask.png"))
                 sample_saved = True
-        result = {
-            "psnr_mean": float(np.mean(psnrs)),
-            "psnr_max": float(np.max(psnrs)),
-            "ssim_mean": float(np.mean(ssims)),
-            "ssim_max": float(np.max(ssims)),
-            "n_images": len(psnrs),
-        }
+        if n_proc > 1:
+            # each process scored its OWN shard of the test split; combine
+            # with a fixed-size allgather of (sum, max, count) — the
+            # per-image vectors have process-dependent lengths. A process
+            # whose shard dropped to zero batches (tiny split) must STILL
+            # enter the collective with empty-safe stats, or the others
+            # hang forever.
+            from jax.experimental import multihost_utils
+
+            stats = np.array(
+                [np.sum(psnrs), np.max(psnrs, initial=-np.inf), len(psnrs),
+                 np.sum(ssims), np.max(ssims, initial=-np.inf)], np.float64,
+            )
+            g = np.asarray(multihost_utils.process_allgather(stats))
+            n_total = g[:, 2].sum()
+            if n_total == 0:
+                raise RuntimeError(
+                    "multi-host eval scored 0 images: the test split is "
+                    "smaller than process_count × test batch — shrink "
+                    "test_batch_size or add test data")
+            result = {
+                "psnr_mean": float(g[:, 0].sum() / n_total),
+                "psnr_max": float(g[:, 1].max()),
+                "ssim_mean": float(g[:, 3].sum() / n_total),
+                "ssim_max": float(g[:, 4].max()),
+                "n_images": int(n_total),
+            }
+        else:
+            result = {
+                "psnr_mean": float(np.mean(psnrs)),
+                "psnr_max": float(np.max(psnrs)),
+                "ssim_mean": float(np.mean(ssims)),
+                "ssim_max": float(np.max(ssims)),
+                "n_images": len(psnrs),
+            }
         if fid_eval is not None and fid_eval.real.n > 1:
             result["vfid"] = fid_eval.compute()
             if self.vgg_source != "pretrained":

@@ -42,14 +42,23 @@ def make_schedule(cfg: OptimConfig, steps_per_epoch: int,
                   epoch_count: int = 1) -> Callable:
     """Per-step lr schedule implementing the epoch-wise reference policies.
 
-    ``epoch_count`` is the 1-based starting epoch (resume offset), as in the
-    reference's ``--epoch_count`` flag.
+    ``epoch_count`` is the 1-based epoch label of **step 0** (the reference's
+    ``--epoch_count`` flag on a FRESH run). When restoring a checkpoint the
+    step counter already encodes every prior epoch, so the caller must pass
+    ``epoch_count=1`` — keeping a >1 offset would count those epochs twice
+    and a decay-window resume would clamp the LR to 0
+    (``Trainer.maybe_resume`` rebuilds the step functions accordingly).
     """
     base = cfg.lr
 
     def schedule(step):
         epoch = jnp.asarray(step) // steps_per_epoch
         if cfg.lr_policy == "lambda":
+            # Only the lambda policy consumes --epoch_count, exactly like
+            # the reference (StepLR / CosineAnnealingLR ignore it —
+            # networks.py:110-117). On RESUME the caller must renormalize
+            # epoch_count against the restored step (Trainer.maybe_resume)
+            # or the offset double-counts into LR=0.
             mult = lambda_rule(epoch, epoch_count, cfg.niter, cfg.niter_decay)
         elif cfg.lr_policy == "step":
             mult = 0.1 ** (epoch // cfg.lr_decay_iters)

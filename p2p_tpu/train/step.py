@@ -217,8 +217,6 @@ def build_train_step(
         # ring buffer in TrainState — no host round-trip inside the scan.
         use_pool = cfg.train.pool_size > 0 and state.pool is not None
         pool1, pool_n1 = state.pool, state.pool_n
-        real_pair = _concat_pair(real_a, real_b)
-        in_c = real_a.shape[-1]
 
         # G-side loss terms, shared by both step structures. ``pred_fake_g``
         # is the multiscale D output on (real_a ‖ fake_b); differentiation
@@ -304,10 +302,21 @@ def build_train_step(
             dvars0 = {"spectral": state.spectral_d}
             if use_quant:
                 dvars0["quant"] = state.quant_d
+            # Concat pairs, NOT the split-stem (a, b) form: feeding D the
+            # unconcatenated halves (models/patchgan._SplitStemConv — no
+            # 6-ch pair tensors, CSE-shared conv(real_a, W_a), structurally
+            # dead real_a dgrad) MEASURED SLOWER on v5e: 1661 vs 1701
+            # img/s at 256²/bs128 — two 3-ch stem convs tile the MXU's
+            # contraction dim worse (2×48-wide im2col vs one 96-wide) and
+            # the concat was already fused into the stem's window gather.
+            # The split path stays op-level (pinned by
+            # tests/test_models.py::test_split_stem_pair_path_equals_concat).
+            in_c = real_a.shape[-1]
             loss_d, grads_d, pred_fake, pred_real, dvars2, pull = (
                 single_forward_d_losses(
                     d_fwd, dvars0, state.params_d,
-                    _concat_pair(real_a, fake_b_primal), real_pair,
+                    _concat_pair(real_a, fake_b_primal),
+                    _concat_pair(real_a, real_b),
                     L.gan_mode,
                 )
             )
@@ -323,6 +332,7 @@ def build_train_step(
             # 3-forward structure (train.py:308,315,336).
             from p2p_tpu.utils.pool import device_pool_query
 
+            real_pair = _concat_pair(real_a, real_b)
             pool_rng = jax.random.fold_in(
                 jax.random.key(cfg.train.seed ^ 0x705501), state.step
             )

@@ -101,23 +101,10 @@ class VideoTrainer:
             cfg, jax.random.key(cfg.train.seed), sample,
             self.steps_per_epoch, dtype,
         )
+        self._dtype = dtype
+        self._build_step_fns()
         if self.mesh is not None:
-            self.train_step = make_parallel_video_step(
-                cfg, self.mesh, self.vgg_params, self.steps_per_epoch, dtype
-            )
             self.state = jax.device_put(self.state, replicated(self.mesh))
-        else:
-            self.train_step = build_video_train_step(
-                cfg, self.vgg_params, self.steps_per_epoch, dtype
-            )
-        self.multi_step = None
-        if cfg.train.scan_steps > 1:
-            from p2p_tpu.train.video_step import build_multi_video_train_step
-
-            self.multi_step = build_multi_video_train_step(
-                cfg, self.vgg_params, self.steps_per_epoch, dtype
-            )
-        self.eval_step = build_video_eval_step(cfg, dtype)
         from p2p_tpu.train.schedules import PlateauController
 
         self.plateau = (
@@ -132,6 +119,26 @@ class VideoTrainer:
         )
         self.epoch = cfg.train.epoch_count
 
+    def _build_step_fns(self) -> None:
+        cfg = self.cfg
+        if self.mesh is not None:
+            self.train_step = make_parallel_video_step(
+                cfg, self.mesh, self.vgg_params, self.steps_per_epoch,
+                self._dtype,
+            )
+        else:
+            self.train_step = build_video_train_step(
+                cfg, self.vgg_params, self.steps_per_epoch, self._dtype
+            )
+        self.multi_step = None
+        if cfg.train.scan_steps > 1:
+            from p2p_tpu.train.video_step import build_multi_video_train_step
+
+            self.multi_step = build_multi_video_train_step(
+                cfg, self.vgg_params, self.steps_per_epoch, self._dtype
+            )
+        self.eval_step = build_video_eval_step(cfg, self._dtype)
+
     def _host_batch_sample(self):
         item = self.train_ds[0]
         bs = self.cfg.data.batch_size
@@ -145,7 +152,20 @@ class VideoTrainer:
         if step is None:
             return False
         self.state = self.ckpt.restore(self.state)
-        self.epoch = 1 + int(step) // self.steps_per_epoch
+        done = int(step) // self.steps_per_epoch
+        self.epoch = max(self.cfg.train.epoch_count, 1 + done)
+        # Renormalize the schedule's epoch offset against the restored
+        # step (see Trainer.maybe_resume for the double-offset analysis;
+        # same bug shape here).
+        eff = max(1, self.cfg.train.epoch_count - done)
+        if eff != self.cfg.train.epoch_count:
+            import dataclasses
+
+            self.cfg = dataclasses.replace(
+                self.cfg,
+                train=dataclasses.replace(self.cfg.train, epoch_count=eff),
+            )
+            self._build_step_fns()
         if self.plateau is not None:
             self.plateau.scale = float(np.asarray(self.state.lr_scale))
         return True

@@ -55,18 +55,38 @@ def capture(args) -> str:
     if args.delayed:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8_delayed=True))
+    if args.thin:
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, thin_head=True))
+    if args.upsample:
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, upsample_mode=args.upsample))
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
-    host = synthetic_batch(batch_size=bs, size=h, width=w,
+    n_frames = cfg.data.n_frames
+    host = synthetic_batch(batch_size=bs * max(n_frames, 1), size=h, width=w,
                            bits=cfg.model.quant_bits)
+    if n_frames > 1:
+        host = {k: v.reshape(bs, n_frames, *v.shape[1:])
+                for k, v in host.items()}
     single = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
-    state = create_train_state(cfg, jax.random.key(0), single,
-                               train_dtype=dtype)
     vgg = (load_vgg19_params(jnp.bfloat16 if dtype is not None
                              else jnp.float32)
            if (cfg.loss.lambda_vgg > 0 or cfg.loss.lambda_style > 0)
            else None)
-    step = build_multi_train_step(cfg, vgg, train_dtype=dtype)
+    if n_frames > 1:
+        from p2p_tpu.train.video_step import (
+            build_multi_video_train_step,
+            create_video_train_state,
+        )
+
+        state = create_video_train_state(cfg, jax.random.key(0), single,
+                                         train_dtype=dtype)
+        step = build_multi_video_train_step(cfg, vgg, train_dtype=dtype)
+    else:
+        state = create_train_state(cfg, jax.random.key(0), single,
+                                   train_dtype=dtype)
+        step = build_multi_train_step(cfg, vgg, train_dtype=dtype)
     batches = {k: jnp.asarray(np.broadcast_to(v, (args.steps,) + v.shape)
                               .copy(), jnp.float32) for k, v in host.items()}
     state, m = step(state, batches)          # compile
@@ -149,6 +169,11 @@ def main() -> None:
                     help="scanned steps inside the traced dispatch")
     ap.add_argument("--delayed", action="store_true",
                     help="stored-scale int8 activation quantization")
+    ap.add_argument("--thin", action="store_true",
+                    help="U-Net image head in the subpixel form (thin_head)")
+    ap.add_argument("--upsample", default=None,
+                    choices=["deconv", "subpixel", "resize"],
+                    help="override the U-Net decoder upsample family")
     ap.add_argument("--top", type=int, default=12,
                     help="kernels to print in the per-kernel table")
     ap.add_argument("--logdir", default="/tmp/p2p_tpu_profile")

@@ -417,3 +417,39 @@ def test_unet_thin_head_swap_equivalent_under_weight_mapping():
     yo, _ = old.apply(vo, x, True, mutable=["batch_stats"])
     np.testing.assert_allclose(np.asarray(yn), np.asarray(yo),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_split_stem_pair_path_equals_concat():
+    """_SplitStemConv: D applied to an UNCONCATENATED (a, b) pair equals D
+    on concat(a, b) — same params (Conv_0 holds the full 6-ch kernel), all
+    scales/stages, and the b-half gradient matches the concat path's
+    sliced cotangent (the train step's grad_fake route)."""
+    import numpy as np
+
+    from p2p_tpu.models.patchgan import MultiscaleDiscriminator
+
+    a = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    b = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    pair = jnp.concatenate([a, b], axis=-1)
+    d = MultiscaleDiscriminator(ndf=8, n_layers=2, num_D=2,
+                                use_spectral_norm=False)
+    vs = d.init(jax.random.key(0), pair)
+    outc = d.apply(vs, pair)
+    outp = d.apply(vs, (a, b))
+    for fc, fp in zip(outc, outp):
+        for x, y in zip(fc, fp):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=2e-5, atol=2e-5)
+
+    def loss_concat(bb):
+        return sum(jnp.sum(o[-1])
+                   for o in d.apply(vs, jnp.concatenate([a, bb], -1)))
+
+    def loss_pair(bb):
+        return sum(jnp.sum(o[-1]) for o in d.apply(vs, (a, bb)))
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_concat)(b)),
+        np.asarray(jax.grad(loss_pair)(b)),
+        rtol=2e-5, atol=2e-5,
+    )

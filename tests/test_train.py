@@ -70,6 +70,40 @@ def test_schedules_per_policy():
     assert float(cos(10)) == pytest.approx(0.0, abs=1e-7)
 
 
+def test_schedule_fresh_epoch_count_matches_reference_formula():
+    """A FRESH run with --epoch_count N starts the lambda curve at epoch N,
+    exactly the reference formula 1 - max(0, e + epoch_count - niter) /
+    (niter_decay + 1) with the scheduler's local 0-based epoch e
+    (networks.py:106-109)."""
+    cfg = OptimConfig(lr=1.0, niter=2, niter_decay=4)
+    sched = make_schedule(cfg, steps_per_epoch=2, epoch_count=5)
+    for step, local_e in [(0, 0), (1, 0), (2, 1), (5, 2)]:
+        ref = max(0.0, 1.0 - max(0, local_e + 5 - 2) / 5.0)
+        assert float(sched(step)) == pytest.approx(ref), (step, local_e)
+
+
+def test_schedule_resume_normalized_continues_curve():
+    """The resume contract (Trainer.maybe_resume rebuilds with
+    epoch_count=1): the schedule of the ABSOLUTE restored step must equal
+    the hand-computed decay curve — with niter=2, niter_decay=4, spe=2,
+    epoch e (0-based) has mult = 1 - max(0, e-1)/5. The buggy round-3
+    wiring (absolute step AND the epoch_count offset) clamps to LR=0
+    instead (hd_r3 bug). The end-to-end contract is pinned by
+    tests/test_loop.py::test_resume_into_decay_window_continues_lr_curve."""
+    cfg = OptimConfig(lr=1.0, niter=2, niter_decay=4)
+    resumed = make_schedule(cfg, steps_per_epoch=2, epoch_count=1)
+    # steps 8..11 are epochs 5-6 (0-based 4-5), inside the decay window
+    for step in range(8, 12):
+        e = step // 2
+        expect = 1.0 - max(0, e + 1 - 2) / 5.0
+        assert float(resumed(step)) == pytest.approx(expect)
+        assert float(resumed(step)) > 0.0
+    # the buggy wiring (restored absolute step AND epoch_count=5 offset)
+    # would clamp to zero here:
+    buggy = make_schedule(cfg, steps_per_epoch=2, epoch_count=5)
+    assert float(buggy(8)) == 0.0
+
+
 def test_plateau_controller():
     pc = PlateauController(patience=2)
     scales = [pc.update(1.0) for _ in range(10)]
