@@ -237,14 +237,16 @@ class _PallasHeadConv(nn.Module):
 
         interpret = jax.devices()[0].platform != "tpu"
         if not interpret and os.environ.get("P2P_HPAL_FORCE", "") != "1":
-            # current Mosaic rejects the kernel's layout folds at odd
-            # spatial extents — see ops/pallas/subpixel_head.py STATUS.
-            # P2P_HPAL_FORCE=1 bypasses the gate to re-probe after TPU
-            # runtime upgrades (the bench's BENCH_HPAL path sets it).
+            # The v3 kernel COMPILES and RUNS on this runtime but measures
+            # 1130 img/s vs 1708 for the XLA deconv head at 256²/bs=128
+            # (sublane-shift chains per band + lost fusions around the
+            # custom call — ops/pallas/subpixel_head.py STATUS). Gated
+            # until a future Mosaic makes it competitive; P2P_HPAL_FORCE=1
+            # (the bench's BENCH_HPAL path) re-measures.
             raise NotImplementedError(
-                "SubpixelDeconv(pallas=True) is interpret-mode only on "
-                "this TPU runtime (Mosaic 'unsupported shape cast'); "
-                "use the default XLA head")
+                "SubpixelDeconv(pallas=True) measures SLOWER than the XLA "
+                "deconv head on this TPU runtime (1130 vs 1708 img/s); "
+                "use the default head, or set P2P_HPAL_FORCE=1 to force")
         y = subpixel_head_conv(x.astype(dt), kernel.astype(dt), interpret)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,

@@ -4,41 +4,66 @@ form, with the k² tap matmuls fused in VMEM.
 
 Why a kernel: the image-producing head (128ch @128² → 3ch @256², ~4 ms of
 the 256²/bs=128 train step) is HBM-bound — XLA's deconv reads the input at
-~390 GB/s forward and its transposed-conv backward materializes spatial
-``reverse`` copies. Every useful formulation is a couple of (P,C)·(C,4F)
-matmuls; what costs is the traffic. This kernel reads x ONCE per sample,
-accumulates the 4 tap matmuls in VMEM, and writes only the tap tensor;
-the shifted depth-to-space stays a cheap jnp pass outside
-(ops/conv.py subpixel_interleave).
+~390 GB/s forward (≈2.4 reads of x per pass) and its transposed-conv
+backward materializes spatial ``reverse`` copies. Every useful formulation
+is a couple of (P,C)·(C,4F) matmuls; what costs is the traffic. These
+kernels read each operand ONCE per pass and write only the tap tensor; the
+shifted depth-to-space stays a cheap jnp pass outside (ops/conv.py
+subpixel_interleave).
 
-Layout: the tap tensor keeps 4F (e.g. 12) in the LANE dim only folded
-into W — ``(H+1, (W+1)·4F)`` — because a trailing 12-channel dim would
-pad to 128 lanes and blow a full-sample f32 accumulator to ~9.5 MB; the
-folded layout is lane-dense (0.9 MB), so one sample per grid step fits
-scoped VMEM with room for double-buffered inputs. Callers reshape
-``(N, H+1, (W+1)·4F) ↔ (N, H+1, W+1, 4F)`` outside (contiguous, free).
+Three designs were carried to hardware before this one:
 
-Backward: dx re-plays the taps transposed (one write of dx, f32 local
-canvas); dW accumulates across the sequential sample grid — race-free
-because TPU grids execute in order (same pattern as the InstanceNorm
-stats kernel).
+- v1 (round 3) folded the tap tensor to ``(H+1, (W+1)·4F)`` for a
+  lane-dense accumulator; Mosaic rejects that lane-regrouping cast
+  ("infer-vector-layout: unsupported shape cast") — re-probed on the
+  round-4 runtime, same error.
+- v2 (H-banded, major-dim reshapes only, halo row via a second BlockSpec)
+  COMPILED — the first on-hardware run of this kernel family — but
+  measured 921 img/s vs 1708 baseline: its per-tap slices shift the
+  SUBLANE dim of the full-width activation (W is not 8-aligned), which
+  Mosaic lowers to large VPU shuffle chains, and its dW contraction runs
+  over the major (position) dim, forcing an in-kernel transpose.
+
+v3 (this file) keeps v2's banding/halo structure and removes both costs:
+
+- all in-kernel widths are padded to multiples of 8, so every reshape is
+  a pure relabeling of sublane tiles;
+- forward: ONE matmul per band against the channel-major weight matrix
+  ``(C, 4·4F)`` produces the tap tensor t; the (dh, dw) shifts land on t
+  (4F lanes — 10× smaller than shifting x) as static offset slices + adds
+  (in-kernel ``jnp.pad`` is rejected by Mosaic as an offset-mismatched
+  concatenate — everything is expressed as slices of a common width);
+- dx: the tap-form mirror — ONE matmul ``dz·Wᵀ_all`` into 4·C lanes, then
+  the four shifts fold its 128-ALIGNED lane blocks into the band;
+- dW is NOT a Pallas kernel: contracting over positions wants positions
+  on lanes (an in-kernel transpose — the v2 killer), and XLA's native
+  conv weight-gradient already reads x and dz once. ``_bwd`` takes the
+  wgrad from ``jax.vjp`` of the plain XLA conv; only its dx/primal paths
+  are replaced.
+
+STATUS (round 4, v5e runtime): v3 compiles AND runs — measured
+1129.8 img/s as the 256²/bs=128 train-step head vs 1708 for the XLA
+deconv head (v2: 921). The remaining cost is structural on this Mosaic
+version: the ±1 offset slices of the tap tensors are sublane-shift chains
+on multi-MB vectors, executed once per (sample × band) grid step, and the
+custom call additionally breaks XLA's fusions around the head (the ReLU
+backward and pad ops that normally fuse into the deconv kernels fall out
+as standalone passes). Keep the XLA head in production; the kernel stays
+behind ``head_pallas`` / ``BENCH_HPAL=1`` for re-measurement on future
+runtimes. Interpret-mode equivalence (fwd + both grads vs the XLA conv)
+is pinned by tests/test_ops.py.
+
+The halo trick (unchanged from v2): the k2 conv's one-row band overlap is
+fed as a SECOND BlockSpec onto the same padded operand — block shape 1 in
+the row dim, so the index map addresses the single halo row ``(hb+1)·B``
+directly. No overlapping block windows, no manual DMA. Bands are
+zero-padded to ``nh·B`` rows; padded rows compute garbage that is sliced
+off (forward) or zeros that contribute nothing (backward).
 
 Weight layout matches ``SubpixelDeconv``'s inner conv (HWIO (2,2,C,4F)) so
 the module's param tree — and the documented ConvTranspose weight mapping
-(tests/test_ops.py) — is unchanged. Tap matmuls and the accumulator are
-f32 (the XLA conv this replaces also accumulates in f32).
-
-STATUS (round 3, v5e runtime): correct in interpret mode (fwd + both
-grads vs the XLA conv, tests/test_ops.py), but the CURRENT Mosaic
-compiler rejects the layout with "infer-vector-layout: unsupported
-shape cast" — the (H·W, C) ↔ (H, W·4F) folds cross the sublane/lane
-tiling at the head's 129-row shape (odd spatial extents), and every
-layout that avoids the fold re-inflates the lane-padded accumulator
-(4F=12 pads to 128 lanes → ~9.5 MB f32) past the ~16 MB scoped-VMEM
-budget alongside double-buffered inputs, or degrades accumulation to
-bf16. Gated off the TPU path in ops/conv.py until Mosaic grows the
-cast; the XLA deconv head (measured equal-best, BASELINE ledger)
-remains the production path.
+(tests/test_ops.py) — is unchanged. Tap matmuls and accumulators are f32
+(the XLA conv this replaces also accumulates in f32).
 """
 
 from __future__ import annotations
@@ -50,68 +75,67 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _fwd_kernel(xp_ref, w_ref, z_ref):
-    """One sample: z[h, w·4F] = Σ_taps xp[h+dh, w+dw, :] @ w[dh,dw]."""
-    _, hp, wp, c = xp_ref.shape          # (1, H+2, W+2, C)
-    _, ho, wf = z_ref.shape              # (1, H+1, (W+1)·4F)
-    f4 = w_ref.shape[-1]
-    wo = wf // f4
-    xp = xp_ref[0]
-    w = w_ref[...].astype(xp.dtype)
-    acc = jnp.zeros((ho * wo, f4), jnp.float32)
-    for dh in range(2):
-        for dw in range(2):
-            xs = xp[dh:dh + ho, dw:dw + wo, :].reshape(ho * wo, c)
-            acc += jax.lax.dot(
-                xs, w[dh, dw], preferred_element_type=jnp.float32
-            )
-    z_ref[0] = acc.reshape(ho, wf)
+def _pick_band(rows: int, target: int) -> int:
+    """Band height ≈ ``target`` rows; whole tensor if it already fits."""
+    if rows <= target:
+        return rows
+    import math
+
+    return math.ceil(rows / math.ceil(rows / target))
 
 
-def _bwd_dx_kernel(dz_ref, w_ref, dxp_ref):
-    """One sample: dxp[h+dh, w+dw, :] += dz[h,w,:] @ w[dh,dw]ᵀ."""
-    _, ho, wf = dz_ref.shape
-    _, hp, wp, c = dxp_ref.shape
-    f4 = w_ref.shape[-1]
-    wo = wf // f4
-    dz = dz_ref[0].reshape(ho * wo, f4)
-    w = w_ref[...].astype(jnp.float32)
-    acc = jnp.zeros((hp, wp, c), jnp.float32)
-    for dh in range(2):
-        for dw in range(2):
-            part = jax.lax.dot(
-                dz, w[dh, dw].T, preferred_element_type=jnp.float32
-            ).reshape(ho, wo, c)
-            acc = acc.at[dh:dh + ho, dw:dw + wo, :].add(part)
+def _align8(v: int) -> int:
+    return -(-v // 8) * 8
+
+
+_FWD_BAND = 32
+_DX_BAND = 16
+
+
+def _fwd_kernel(xm_ref, xh_ref, wall_ref, z_ref):
+    """One (sample, band): t = x·W_all, then the 4 tap shifts fold t into
+    the band's z rows. Shifts are static offset SLICES of the 4F-lane tap
+    tensor only (no pads/concats — Mosaic rejects in-kernel pad as an
+    offset-mismatched concatenate)."""
+    _, bb, wpa, c = xm_ref.shape         # (1, B, WP, C) — WP 8-aligned
+    wout = z_ref.shape[2]                # WP - 1
+    f4 = z_ref.shape[-1]
+    xfull = jnp.concatenate([xm_ref[0], xh_ref[0]], axis=0)   # (B+1, WP, c)
+    wall = wall_ref[...].astype(xfull.dtype)                  # (c, 4·f4)
+    t = jax.lax.dot(
+        xfull.reshape((bb + 1) * wpa, c), wall,
+        preferred_element_type=jnp.float32,
+    ).reshape(bb + 1, wpa, 4 * f4)
+    # z[h, w] = Σ_{dh,dw} t[h+dh, w+dw, (2·dh+dw)·f4 : +f4]
+    z_ref[0] = (
+        t[0:bb, 0:wout, 0:f4]
+        + t[0:bb, 1:wout + 1, f4:2 * f4]
+        + t[1:bb + 1, 0:wout, 2 * f4:3 * f4]
+        + t[1:bb + 1, 1:wout + 1, 3 * f4:4 * f4]
+    )
+
+
+def _bwd_dx_kernel(dzm_ref, dzh_ref, wtall_ref, dxp_ref):
+    """One (sample, band) of dxp — the tap-form mirror of the forward:
+    u = dz·Wᵀ_all (one matmul, 4·C output lanes), then the 4 shifts fold
+    u's 128-aligned lane blocks into the band. Shifts are offset slices
+    of u's sublane dim; lane selection stays tile-aligned."""
+    _, bb, wz, f4 = dzm_ref.shape        # (1, B2, WZ, f4)
+    _, _, wpx, c = dxp_ref.shape         # (1, B2, WPX, c)
+    dzfull = jnp.concatenate([dzm_ref[0], dzh_ref[0]], axis=0)
+    wtall = wtall_ref[...]               # (f4, 4·c), f32
+    u = jax.lax.dot(
+        dzfull.reshape((bb + 1) * wz, f4).astype(jnp.float32), wtall,
+        preferred_element_type=jnp.float32,
+    ).reshape(bb + 1, wz, 4 * c)
+    # dxp[r, s] = Σ_{dh,dw} u[r+1-dh, s+1-dw, (2·dh+dw)·c : +c]
+    acc = (
+        u[1:1 + bb, 1:1 + wpx, 0:c]
+        + u[1:1 + bb, 0:wpx, c:2 * c]
+        + u[0:bb, 1:1 + wpx, 2 * c:3 * c]
+        + u[0:bb, 0:wpx, 3 * c:4 * c]
+    )
     dxp_ref[0] = acc.astype(dxp_ref.dtype)
-
-
-def _bwd_dw_kernel(xp_ref, dz_ref, dw_ref):
-    """dW[dh,dw] = Σ_samples xpᵀ_shifted · dz, accumulated across the
-    sequential sample grid (first-visit init, then +=)."""
-    n = pl.program_id(0)
-    _, hp, wp, c = xp_ref.shape
-    _, ho, wf = dz_ref.shape
-    f4 = dw_ref.shape[-1]
-    wo = wf // f4
-    xp = xp_ref[0]
-    dz = dz_ref[0].reshape(ho * wo, f4).astype(jnp.float32)
-    parts = []
-    for dh in range(2):
-        for dw in range(2):
-            xs = xp[dh:dh + ho, dw:dw + wo, :].reshape(ho * wo, c)
-            parts.append(jax.lax.dot(
-                xs.T.astype(jnp.float32), dz,
-                preferred_element_type=jnp.float32))
-    dw_now = jnp.stack(parts).reshape(2, 2, c, f4)
-
-    @pl.when(n == 0)
-    def _init():
-        dw_ref[...] = dw_now
-
-    @pl.when(n != 0)
-    def _acc():
-        dw_ref[...] += dw_now
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -130,19 +154,28 @@ def _fwd(x, w, interpret):
     n, h, wd, c = x.shape
     f4 = w.shape[-1]
     ho, wo = h + 1, wd + 1
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    bb = _pick_band(ho, _FWD_BAND)
+    nh = -(-ho // bb)
+    wpa = _align8(wd + 2)
+    xp = jnp.pad(x, ((0, 0), (1, nh * bb + 1 - (h + 1)),
+                     (1, wpa - 1 - wd), (0, 0)))
+    # W_all[c, (2·dh+dw)·f4+f] = w[dh, dw, c, f]
+    wall = jnp.transpose(w, (2, 0, 1, 3)).reshape(c, 4 * f4)
+    wout = wpa - 1
     zf = pl.pallas_call(
         _fwd_kernel,
-        grid=(n,),
+        grid=(n, nh),
         in_specs=[
-            pl.BlockSpec((1, h + 2, wd + 2, c), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((2, 2, c, f4), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, bb, wpa, c), lambda i, hb: (i, hb, 0, 0)),
+            pl.BlockSpec((1, 1, wpa, c),
+                         lambda i, hb, _bb=bb: (i, (hb + 1) * _bb, 0, 0)),
+            pl.BlockSpec((c, 4 * f4), lambda i, hb: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, ho, wo * f4), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, ho, wo * f4), jnp.float32),
+        out_specs=pl.BlockSpec((1, bb, wout, f4), lambda i, hb: (i, hb, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, nh * bb, wout, f4), jnp.float32),
         interpret=interpret,
-    )(xp, w)
-    return zf.reshape(n, ho, wo, f4), (x, w)
+    )(xp, xp, wall)
+    return zf[:, :ho, :wo], (x, w)
 
 
 def _bwd(interpret, res, dz):
@@ -150,32 +183,48 @@ def _bwd(interpret, res, dz):
     n, h, wd, c = x.shape
     f4 = w.shape[-1]
     ho, wo = h + 1, wd + 1
-    dzf = dz.astype(jnp.float32).reshape(n, ho, wo * f4)
+    hp = h + 2
+    dzf = dz.astype(jnp.float32)
+
+    # ---- dx: band over the padded-input rows -----------------------------
+    b2 = _pick_band(hp, _DX_BAND)
+    nh2 = -(-hp // b2)
+    wpx = _align8(wd + 2)
+    wz = _align8(wpx + 1)
+    # dzp2[i, j] = dz[i-1, j-1], rows padded through the last band's halo
+    dzp2 = jnp.pad(dzf, ((0, 0), (1, nh2 * b2 + 1 - (ho + 1)),
+                         (1, wz - 1 - wo), (0, 0)))
+    # Wᵀ_all[f, (2·dh+dw)·c + ch] = w[dh, dw, ch, f]
+    wtall = jnp.transpose(w.astype(jnp.float32), (3, 0, 1, 2)).reshape(
+        f4, 4 * c)
     dxp = pl.pallas_call(
         _bwd_dx_kernel,
-        grid=(n,),
+        grid=(n, nh2),
         in_specs=[
-            pl.BlockSpec((1, ho, wo * f4), lambda i: (i, 0, 0)),
-            pl.BlockSpec((2, 2, c, f4), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, b2, wz, f4), lambda i, hb: (i, hb, 0, 0)),
+            pl.BlockSpec((1, 1, wz, f4),
+                         lambda i, hb, _b2=b2: (i, (hb + 1) * _b2, 0, 0)),
+            pl.BlockSpec((f4, 4 * c), lambda i, hb: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, h + 2, wd + 2, c),
-                               lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, h + 2, wd + 2, c), x.dtype),
+        out_specs=pl.BlockSpec((1, b2, wpx, c), lambda i, hb: (i, hb, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, nh2 * b2, wpx, c), x.dtype),
         interpret=interpret,
-    )(dzf, w)
+    )(dzp2, dzp2, wtall)
     dx = dxp[:, 1:1 + h, 1:1 + wd, :]
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    dw = pl.pallas_call(
-        _bwd_dw_kernel,
-        grid=(n,),
-        in_specs=[
-            pl.BlockSpec((1, h + 2, wd + 2, c), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, ho, wo * f4), lambda i: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((2, 2, c, f4), lambda i: (0, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((2, 2, c, f4), jnp.float32),
-        interpret=interpret,
-    )(xp, dzf)
+
+    # ---- dW: XLA's native conv weight-gradient ---------------------------
+    # Contracting over positions on the MXU wants positions on lanes — an
+    # in-kernel transpose (the v2 performance killer). XLA's wgrad conv
+    # reads x and dz once; let it have this contraction.
+    def conv_w(w_):
+        return jax.lax.conv_general_dilated(
+            x, w_, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    out_aval = jax.eval_shape(conv_w, w)
+    # linear_transpose: the wgrad alone, with no dead primal forward
+    wvjp = jax.linear_transpose(conv_w, w)
+    (dw,) = wvjp(dzf.astype(out_aval.dtype))
     return dx, dw.astype(w.dtype)
 
 

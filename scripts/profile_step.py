@@ -58,6 +58,10 @@ def capture(args) -> str:
     if args.thin:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, thin_head=True))
+    if args.hpal:
+        os.environ["P2P_HPAL_FORCE"] = "1"
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, thin_head=True, head_pallas=True))
     if args.upsample:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, upsample_mode=args.upsample))
@@ -171,6 +175,10 @@ def main() -> None:
                     help="stored-scale int8 activation quantization")
     ap.add_argument("--thin", action="store_true",
                     help="U-Net image head in the subpixel form (thin_head)")
+    ap.add_argument("--hpal", action="store_true",
+                    help="thin head through the Pallas kernel (bypasses "
+                         "the slower-than-XLA perf gate in ops/conv.py "
+                         "for re-measurement)")
     ap.add_argument("--upsample", default=None,
                     choices=["deconv", "subpixel", "resize"],
                     help="override the U-Net decoder upsample family")
