@@ -85,13 +85,21 @@ def vgg19_params_source() -> str:
     return "pretrained" if vgg19_npz_path() else "random"
 
 
-def load_vgg19_params(dtype=jnp.float32):
-    """Build the frozen VGG19 param tree (pretrained npz or fixed-seed random)."""
+def load_vgg19_params(dtype=jnp.float32, seed: int = 190):
+    """Build the frozen VGG19 param tree (pretrained npz or fixed-seed
+    random).
+
+    ``seed`` selects the random-feature draw when no pretrained asset
+    exists — the multi-seed VFID robustness protocol
+    (scripts/eval_fid_parity.py --seeds) scores the same predictions
+    under several independent extractors; it is ignored when the npz
+    asset is present.
+    """
     path = vgg19_npz_path()
     model = VGG19Features()
     if path is None:
         dummy = jnp.zeros((1, 64, 64, 3), dtype)
-        return model.init(jax.random.key(190), dummy)["params"]
+        return model.init(jax.random.key(seed), dummy)["params"]
     data = np.load(path)
     params = {}
     for name, ch in _CFG:

@@ -12,6 +12,7 @@ same choice the reference made to avoid checkerboard artifacts.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -95,6 +96,7 @@ class ConvLayer(nn.Module):
     @nn.compact
     def __call__(self, x):
         pad = self.kernel_size // 2
+        in_c = x.shape[-1]
         x = reflect_pad_2d(x, pad)
         if self.int8:
             from p2p_tpu.ops.int8 import QuantConv
@@ -104,6 +106,31 @@ class ConvLayer(nn.Module):
                 strides=self.stride, padding=0, use_bias=self.use_bias,
                 dtype=self.dtype, kernel_init=self.kernel_init,
                 name="Conv_0", delayed=self.int8_delayed,
+            )(x)
+        if self.stride == 1 and in_c <= 8 and self.features >= 16:
+            # thin-INPUT stems (RGB → ngf at full res, e.g. the pix2pixHD
+            # enhancer's k7 stem): XLA's conv/wgrad collapse to
+            # 0.5-0.6 TF/s at these shapes — one materialized patch
+            # tensor turns fwd and wgrad into dense matmuls (PatchesConv)
+            return PatchesConv(
+                self.features, kernel_size=self.kernel_size,
+                use_bias=self.use_bias, dtype=self.dtype,
+                kernel_init=self.kernel_init, name="Conv_0",
+            )(x)
+        if self.stride == 1 and (self.features * 16 <= in_c
+                                 or (self.features <= 4 and in_c >= 16)):
+            # thin image heads (e.g. the ResNet/Expand generators' k9→3
+            # and the pix2pixHD enhancer's k7→3): XLA's conv runs the MXU
+            # at ~4.5 TF/s with 3 of 128 output lanes live (profiled
+            # 2.3 ms/step fwd on cityscapes 512×256). ThinHeadConv, NOT
+            # KN2RowConv: the kn2row forward is right, but its naive
+            # autodiff backward is k² sequential pad+adds (profiled
+            # 296 ms/step at k7 — the hand-written VJP through patches
+            # of dz is the fix). Param tree unchanged (Conv_0).
+            return ThinHeadConv(
+                self.features, kernel_size=self.kernel_size,
+                use_bias=self.use_bias, dtype=self.dtype,
+                kernel_init=self.kernel_init, name="Conv_0",
             )(x)
         return save_conv_out(nn.Conv(
             features=self.features,
@@ -160,6 +187,161 @@ def kn2row_thin_conv(x: jax.Array, w: jax.Array, pad: int) -> jax.Array:
             z, (0, dh, dw, t, 0), (n, ho, wo, 1, o)
         ).reshape(n, ho, wo, o).astype(jnp.float32)
     return y.astype(x.dtype)
+
+
+def im2col_patches(x: jax.Array, k: int) -> jax.Array:
+    """VALID im2col: (N, H, W, C) → (N, H−k+1, W−k+1, k²·C), feature
+    order (kh, kw, c) — i.e. an HWIO kernel flattens to the matching
+    matrix with a plain ``w.reshape(k·k·C, F)``.
+
+    Built from k² static slices + one channel concat (pure HBM movement
+    at full rate) — NOT ``lax.conv_general_dilated_patches``, whose
+    lowering is itself a thin-input conv and inherits the 3 TF/s
+    pathology this path exists to avoid (measured on the pix2pixHD
+    enhancer stem).
+    """
+    n, h, w, c = x.shape
+    ho, wo = h - k + 1, w - k + 1
+    cols = [
+        jax.lax.slice(x, (0, kh, kw, 0), (n, kh + ho, kw + wo, c))
+        for kh in range(k) for kw in range(k)
+    ]
+    return jnp.concatenate(cols, axis=-1)
+
+
+class PatchesConv(nn.Module):
+    """Stride-1 conv for THIN-INPUT stems (C_in ≤ 8, e.g. the pix2pixHD
+    enhancer's RGB stem at 1024×512) as explicit im2col patches + one
+    dense matmul.
+
+    XLA's conv kernels collapse on 3-input-channel convs at big spatial
+    extents: the pix2pixHD enhancer stem profiled 0.6 TF/s forward and
+    its weight gradient 0.5 TF/s / 4 GB/s (~11 ms/step of a 141 ms step).
+    The patch tensor is materialized once (~150 MB bf16 at 1024×512 —
+    C_in is tiny, so the k² blow-up is bounded), after which forward AND
+    weight-gradient are plain full-rate ``dot_general``s.
+
+    The INPUT cotangent transposes through the slice-concat as a k²-pad
+    accumulation — slow at big k, but for the stems this dispatch targets
+    it is dead code (the input is the image) and XLA removes it; a
+    learned input would be correct but slow (use ThinHeadConv's dz-side
+    patches instead if that ever matters).
+
+    Param tree ("kernel" HWIO + "bias") matches ``nn.Conv``; callers name
+    it ``Conv_0`` so checkpoints interchange. Input arrives pre-padded
+    (VALID), as with the other ConvLayer branches.
+    """
+
+    features: int
+    kernel_size: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            (k, k, cin, self.features), jnp.float32)
+        dt = self.dtype or jnp.float32
+        patches = im2col_patches(x.astype(dt), k)
+        wmat = kernel.reshape(k * k * cin, self.features)
+        y = jax.lax.dot_general(
+            patches, wmat.astype(dt), (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dt)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(y.dtype)
+        return save_conv_out(y)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=())
+def thin_head_conv(x: jax.Array, w: jax.Array) -> jax.Array:
+    """VALID stride-1 conv for THIN-OUTPUT heads (F ≤ 4 from a wide
+    trunk, e.g. ResNet-G's k9→3 and the pix2pixHD enhancer's k7→3 image
+    heads), with a hand-written VJP.
+
+    Forward is the kn2row tap decomposition (one full-rate matmul + k²
+    shifted slice-adds on the tiny tap tensor). The NAIVE autodiff of
+    that forward transposes the slice-adds into k² sequential full-size
+    pad+add kernels — profiled 296 ms/step (0 TF/s, 1 GB/s) on the
+    pix2pixHD head, 2/3 of the whole step — so the backward here is
+    derived by hand THROUGH PATCHES OF dz (which is the thin tensor, so
+    its k²·F-channel patch tensor stays small):
+
+      dx = patches(pad(dz, k−1)) @ flip(w)ᵀ          (one matmul)
+      dw = xpadᵀ ⋅ patches(pad(dz, k−1))             (one matmul, then
+                                                      unflip/reorder)
+
+    using that patches(pad(dz, k−1)) at position q holds
+    dz[q − (k−1) + (kh′,kw′)], i.e. every shifted dz view both
+    cotangents need. x arrives pre-padded (VALID), matching ConvLayer.
+    """
+    return kn2row_thin_conv(x, w, 0)
+
+
+def _thin_head_fwd(x, w):
+    return kn2row_thin_conv(x, w, 0), (x, w)
+
+
+def _thin_head_bwd(res, dz):
+    x, w = res
+    kh, kw_, cin, f = w.shape
+    assert kh == kw_, "square kernels only"
+    k = kh
+    dzf = dz.astype(x.dtype)
+    # patches of the (k−1)-padded dz: position q (over xpad coords) holds
+    # dz[q − (k−1) + (kh′, kw′)] at feature (kh′, kw′, f)
+    dzp = jnp.pad(dzf, ((0, 0), (k - 1, k - 1), (k - 1, k - 1), (0, 0)))
+    pz = im2col_patches(dzp, k)            # (N, Hp, Wp, k²·f)
+    # dx[q, c] = Σ_{kh,kw} dz[q − (kh,kw)] · w[kh,kw,c]
+    #          = Σ_{kh′=k−1−kh} pz[q, (kh′,kw′,f)] · w[kh,kw,c,f]
+    wd = jnp.flip(w, (0, 1)).transpose(0, 1, 3, 2).reshape(
+        k * k * f, cin)                    # [(kh′,kw′,f), c]
+    dx = jax.lax.dot_general(
+        pz, wd.astype(pz.dtype), (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    # dw[kh,kw,c,f] = Σ_p xpad[p + (kh,kw), c] · dz[p, f]
+    #              = Σ_q xpad[q, c] · pz[q, (k−1−kh, k−1−kw, f)]
+    dwm = jax.lax.dot_general(
+        x, pz, (((0, 1, 2), (0, 1, 2)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                      # (c, k²·f) in (kh′,kw′,f) order
+    dw = jnp.flip(
+        dwm.reshape(cin, k, k, f), (1, 2)
+    ).transpose(1, 2, 0, 3)
+    return dx, dw.astype(w.dtype)
+
+
+thin_head_conv.defvjp(_thin_head_fwd, _thin_head_bwd)
+
+
+class ThinHeadConv(nn.Module):
+    """Stride-1 thin-OUTPUT conv module on the custom-VJP kn2row path
+    (see :func:`thin_head_conv`). Param tree matches ``nn.Conv``."""
+
+    features: int
+    kernel_size: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.kernel_size
+        kernel = self.param("kernel", self.kernel_init,
+                            (k, k, x.shape[-1], self.features), jnp.float32)
+        dt = self.dtype or jnp.float32
+        y = thin_head_conv(x.astype(dt), kernel.astype(dt))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(y.dtype)
+        return save_conv_out(y)
 
 
 class KN2RowConv(nn.Module):
@@ -330,7 +512,18 @@ class UpsampleConvLayer(nn.Module):
         if self.upsample:
             x = upsample_nearest(x, self.upsample)
         pad = self.kernel_size // 2
+        in_c = x.shape[-1]
         x = reflect_pad_2d(x, pad)
+        if self.stride == 1 and (self.features * 16 <= in_c
+                                 or (self.features <= 4 and in_c >= 16)):
+            # thin image heads (ExpandNetwork's k9→3 lives HERE, not in
+            # ConvLayer — networks.py:518-520): same ThinHeadConv
+            # dispatch as ConvLayer, same param tree (Conv_0)
+            return ThinHeadConv(
+                self.features, kernel_size=self.kernel_size,
+                use_bias=self.use_bias, dtype=self.dtype,
+                kernel_init=self.kernel_init, name="Conv_0",
+            )(x)
         return save_conv_out(nn.Conv(
             features=self.features,
             kernel_size=(self.kernel_size, self.kernel_size),

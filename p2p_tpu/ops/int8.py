@@ -57,16 +57,18 @@ _DN = ("NHWC", "HWIO", "NHWC")
 # Dispatch bounds for the unrolled int8 wgrad (see _int8_bwd_core):
 # output spatial sizes in [MIN, MAX] use the k²-unrolled int8
 # dot_general form; the rest fall back to the bf16 CHWN conv.
-# - MIN = 256: below ~16² output positions the int8 strided slices
-#   kernel-fault the CURRENT v5e TPU runtime (reproduced on 4×4 inputs;
-#   tests/test_int8.py carries a skippable on-TPU repro) — this bound is
-#   runtime-version-scoped, not physics: if a runtime upgrade fixes the
-#   fault, set P2P_INT8_WGRAD_SLICE_MIN=0 and re-run the repro test.
+# - MIN = 0 (round 4): the round-2/3 runtime kernel-faulted the int8
+#   strided slices below ~16² output positions (MIN was 256 then); the
+#   round-4 runtime upgrade FIXED it — verified by the on-TPU repro
+#   (tests/test_int8.py::test_tiny_spatial_wgrad_guard_on_tpu, which ran
+#   the unguarded 2×2-output wgrad successfully). The env knob stays for
+#   older runtimes: set P2P_INT8_WGRAD_SLICE_MIN=256 to restore the
+#   guard if the fault reappears.
 # - MAX = 4096 (64²): above it the k² slices of the padded input
 #   materialize more HBM traffic than the int8 MXU rate buys back (the
 #   round-2 "decoder int8 loses" finding).
 _INT8_WGRAD_SLICE_MIN = int(
-    os.environ.get("P2P_INT8_WGRAD_SLICE_MIN", "256"))
+    os.environ.get("P2P_INT8_WGRAD_SLICE_MIN", "0"))
 _INT8_WGRAD_SLICE_MAX = int(
     os.environ.get("P2P_INT8_WGRAD_SLICE_MAX", "4096"))
 
@@ -202,14 +204,14 @@ def _int8_bwd_core(strides, padding, lhs_dilation, res, g):
 
     # ---- wgrad --------------------------------------------------------
     ho, wo = out_hw
-    # int8 slices + dot_general kernel-fault the v5e runtime below ~16²
-    # output positions (reproduced: stride-2 slices at 4×4 input crash
-    # the TPU worker; the identical pattern at 64²+ is fine) — and the
-    # MXU gain is negligible there anyway. Static spatial guard, with an
-    # UPPER bound too: above ~64² output positions the k² strided slices
-    # of the (already large) padded input materialize more HBM traffic
-    # than the int8 MXU rate buys back (the round-2 "decoder int8 loses"
-    # finding) — those big-spatial wgrads take the bf16 CHWN conv below.
+    # Static spatial dispatch window. The round-2/3 runtime kernel-faulted
+    # the int8 strided slices below ~16² output positions (MIN was 256);
+    # the round-4 runtime fixed it and the default window now starts at 0
+    # (see _INT8_WGRAD_SLICE_MIN above). The UPPER bound stands: above
+    # ~64² output positions the k² strided slices of the (already large)
+    # padded input materialize more HBM traffic than the int8 MXU rate
+    # buys back (the round-2 "decoder int8 loses" finding) — those
+    # big-spatial wgrads take the bf16 CHWN conv below.
     if plain and _INT8_WGRAD_SLICE_MIN <= ho * wo <= _INT8_WGRAD_SLICE_MAX:
         sg = absmax_scale(gf)
         gq = quantize_int8(gf, sg)

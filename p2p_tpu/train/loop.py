@@ -559,6 +559,10 @@ class Trainer:
             if cfg.train.eval_every_epoch:
                 record.update(self.evaluate(save_samples=True))
             history.append(record)
+            # epoch summary (incl. lr) into the metrics stream — the
+            # jsonl otherwise only carries per-step and eval records, so
+            # LR continuity across a resume would be unobservable
+            self.logger.log({"kind": "epoch", **record}, force=True)
             if self.plateau is not None and "loss_g" in record:
                 # feed the generator loss, mode='min' (reference plateau);
                 # the returned scale multiplies every optimizer update

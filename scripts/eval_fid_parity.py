@@ -46,6 +46,11 @@ def main(argv=None) -> int:
     ap.add_argument("--jax_preds", required=True)
     ap.add_argument("--size", type=int, default=256)
     ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seeds", default="190",
+                    help="comma-separated extractor seeds; >1 adds the "
+                         "multi-seed robustness rows (mean±range over "
+                         "independent random-VGG draws — shows the parity "
+                         "RANKING is not an artifact of one draw)")
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
@@ -61,21 +66,61 @@ def main(argv=None) -> int:
         raise RuntimeError("no common prediction filenames")
     print(f"{len(names)} common test predictions")
 
-    feature_fn = make_vgg_feature_fn(load_vgg19_params(jnp.float32))
+    seeds = [int(s) for s in args.seeds.split(",")]
+    if len(seeds) > 1 and vgg19_params_source() == "pretrained":
+        raise SystemExit(
+            "--seeds with >1 seed is meaningless with the pretrained VGG19 "
+            "npz present: load_vgg19_params ignores the seed and every "
+            "'draw' would be the same extractor. Drop --seeds (or unset "
+            "P2P_TPU_VGG19_NPZ to test random-extractor robustness).")
 
-    def stats(path):
-        rs = RunningStats(1472)
-        for i in range(0, len(names), args.batch):
-            batch = load_dir(path, names[i:i + args.batch], args.size)
-            rs.update(feature_fn(jnp.asarray(batch)))
-        return rs.finalize()
+    # decode each directory ONCE; only the extractor changes per seed
+    batches = {}
+    for tag, path in (("gt", args.gt), ("torch", args.torch_preds),
+                      ("jax", args.jax_preds)):
+        batches[tag] = [
+            load_dir(path, names[i:i + args.batch], args.size)
+            for i in range(0, len(names), args.batch)
+        ]
 
-    mu_g, cov_g = stats(args.gt)
-    results = {}
-    for tag, path in (("torch", args.torch_preds), ("jax", args.jax_preds)):
-        mu, cov = stats(path)
-        results[f"vfid_{tag}"] = float(frechet_distance(mu_g, cov_g, mu, cov))
+    per_seed = {"torch": [], "jax": []}
+    for seed in seeds:
+        feature_fn = make_vgg_feature_fn(
+            load_vgg19_params(jnp.float32, seed=seed))
+
+        def stats(tag):
+            rs = RunningStats(1472)
+            for batch in batches[tag]:
+                rs.update(feature_fn(jnp.asarray(batch)))
+            return rs.finalize()
+
+        mu_g, cov_g = stats("gt")
+        for tag in ("torch", "jax"):
+            mu, cov = stats(tag)
+            per_seed[tag].append(
+                float(frechet_distance(mu_g, cov_g, mu, cov)))
+        print(f"seed {seed}: torch {per_seed['torch'][-1]:.3f} "
+              f"jax {per_seed['jax'][-1]:.3f}")
+
+    results = {
+        # seed[0] keeps the historical single-seed row comparable
+        "vfid_torch": per_seed["torch"][0],
+        "vfid_jax": per_seed["jax"][0],
+    }
     results["parity_delta"] = abs(results["vfid_jax"] - results["vfid_torch"])
+    if len(seeds) > 1:
+        results["seeds"] = seeds
+        for tag in ("torch", "jax"):
+            v = per_seed[tag]
+            results[f"vfid_{tag}_by_seed"] = [round(x, 4) for x in v]
+            results[f"vfid_{tag}_mean"] = round(sum(v) / len(v), 4)
+            results[f"vfid_{tag}_range"] = [round(min(v), 4),
+                                            round(max(v), 4)]
+        results["jax_lower_seeds"] = sum(
+            j < t for j, t in zip(per_seed["jax"], per_seed["torch"]))
+        results["parity_delta_by_seed"] = [
+            round(abs(j - t), 4)
+            for j, t in zip(per_seed["jax"], per_seed["torch"])]
     results["n_images"] = len(names)
     results["feature_source"] = vgg19_params_source()
     results["extractor"] = "shared fixed-seed VGG19 taps, pooled, D=1472"

@@ -319,16 +319,18 @@ print("OK", os.environ.get("P2P_INT8_WGRAD_SLICE_MIN", "default"))
 
 @pytest.mark.slow
 def test_tiny_spatial_wgrad_guard_on_tpu():
-    """Pins the ops/int8.py kernel-fault guard (_INT8_WGRAD_SLICE_MIN) on
-    REAL TPU hardware — the fault is a property of the current TPU
-    runtime, invisible on the CPU backend this suite pins.
+    """Pins the ops/int8.py tiny-spatial int8 wgrad on REAL TPU hardware
+    (invisible on the CPU backend this suite pins).
 
-    Default mode: runs the tiny-spatial backward through the GUARDED
-    dispatch (bf16 fallback) in a TPU subprocess and requires success.
-    With P2P_RUN_FAULT_REPRO=1 it ALSO runs the unguarded int8 slice
-    path (P2P_INT8_WGRAD_SLICE_MIN=0): if that now succeeds, the runtime
-    fixed the fault and the guard can be retired — the test FAILS with a
-    retire-the-guard message so the change is noticed.
+    History: the round-2/3 runtime kernel-faulted the int8 strided-slice
+    wgrad below ~16² output positions, guarded by
+    _INT8_WGRAD_SLICE_MIN=256; the round-4 runtime fixed it (verified by
+    this test's former P2P_RUN_FAULT_REPRO branch failing with its
+    retire-the-guard message) and the default window now starts at 0.
+    Default mode runs the tiny-spatial backward through the DEFAULT
+    dispatch — now the previously-faulting int8 slice path — and requires
+    success; if a future runtime regresses, this fails and the guard env
+    (P2P_INT8_WGRAD_SLICE_MIN=256) is the mitigation.
     """
     import subprocess
     import sys
@@ -343,23 +345,23 @@ def test_tiny_spatial_wgrad_guard_on_tpu():
     if "tpu" not in probe.stdout:
         pytest.skip(f"no TPU visible outside the CPU-pinned suite "
                     f"(got {probe.stdout.strip()!r})")
-    guarded = subprocess.run(
+    default = subprocess.run(
         [sys.executable, "-c", TINY_WGRAD_SNIPPET],
         capture_output=True, text=True, env=env, timeout=600,
     )
+    assert default.returncode == 0, (
+        "tiny-spatial int8 wgrad FAILED on this TPU runtime — the round-2 "
+        "kernel-fault may be back; mitigate with "
+        "P2P_INT8_WGRAD_SLICE_MIN=256 and restore the guard default in "
+        f"ops/int8.py:\n{default.stderr[-2000:]}"
+    )
+    # the bf16 fallback window must also stay healthy
+    env2 = dict(env, P2P_INT8_WGRAD_SLICE_MIN="256")
+    guarded = subprocess.run(
+        [sys.executable, "-c", TINY_WGRAD_SNIPPET],
+        capture_output=True, text=True, env=env2, timeout=600,
+    )
     assert guarded.returncode == 0, (
-        f"guarded tiny-spatial int8 backward failed on TPU:\n"
+        f"guarded (bf16-fallback) tiny-spatial backward failed on TPU:\n"
         f"{guarded.stderr[-2000:]}"
     )
-    if os.environ.get("P2P_RUN_FAULT_REPRO") == "1":
-        env2 = dict(env, P2P_INT8_WGRAD_SLICE_MIN="0")
-        raw = subprocess.run(
-            [sys.executable, "-c", TINY_WGRAD_SNIPPET],
-            capture_output=True, text=True, env=env2, timeout=600,
-        )
-        assert raw.returncode != 0, (
-            "the unguarded tiny-spatial int8 wgrad now SUCCEEDS on this "
-            "TPU runtime — the kernel-fault is fixed; retire "
-            "_INT8_WGRAD_SLICE_MIN (ops/int8.py) after re-sweeping the "
-            "dispatch bounds."
-        )
