@@ -310,7 +310,13 @@ def test_evaluate_pad_and_trim_across_data_shards(tmp_path):
         parallel=dataclasses.replace(cfg.parallel, mesh=MeshSpec(data=1)),
     )
     tr2 = Trainer(cfg2, data_root=root, workdir=str(tmp_path))
-    tr2.state = tr.state
+    # cross-mesh handoff: tr's state is replicated over ITS (data=2) mesh;
+    # re-place onto tr2's single-device mesh
+    import jax
+
+    from p2p_tpu.core.mesh import replicated
+
+    tr2.state = jax.device_put(tr.state, replicated(tr2.mesh))
     result2 = tr2.evaluate()
     assert result2["n_images"] == 5
     assert result["psnr_mean"] == pytest.approx(result2["psnr_mean"],
