@@ -64,6 +64,32 @@ def save_conv_out(y: jax.Array) -> jax.Array:
     return checkpoint_name(y, "conv_out")
 
 
+# Spatial gate for the thin-conv dispatches (PatchesConv / ThinHeadConv):
+# XLA's thin-channel conv collapse is catastrophic at LARGE spatial extents
+# (pix2pixHD 1024×512: 0.5-1 TF/s, +14% step win from the dispatches) but
+# at small extents the dispatches' own overheads win instead — measured:
+# ExpandNetwork's k9 head at 256²/bs=1 regressed 0.059 → 0.087 s/step
+# (the k²-tap tensor + slice-adds), cityscapes 512×256 was a wash. Gate on
+# the padded spatial area; 300k ≈ "bigger than 512×512".
+_THIN_DISPATCH_MIN_PIXELS = 300_000
+
+
+def _thin_head_eligible(x, features: int, stride: int) -> bool:
+    """Shared ConvLayer/UpsampleConvLayer predicate for the ThinHeadConv
+    dispatch (x is the PADDED input)."""
+    in_c = x.shape[-1]
+    return (stride == 1
+            and x.shape[1] * x.shape[2] >= _THIN_DISPATCH_MIN_PIXELS
+            and (features * 16 <= in_c
+                 or (features <= 4 and in_c >= 16)))
+
+
+def _thin_stem_eligible(x, features: int, stride: int) -> bool:
+    """Shared predicate for the PatchesConv thin-INPUT stem dispatch."""
+    return (stride == 1 and x.shape[-1] <= 8 and features >= 16
+            and x.shape[1] * x.shape[2] >= _THIN_DISPATCH_MIN_PIXELS)
+
+
 def reflect_pad_2d(x: jax.Array, pad: int) -> jax.Array:
     """Reflection-pad H and W of an NHWC tensor."""
     if pad == 0:
@@ -96,7 +122,6 @@ class ConvLayer(nn.Module):
     @nn.compact
     def __call__(self, x):
         pad = self.kernel_size // 2
-        in_c = x.shape[-1]
         x = reflect_pad_2d(x, pad)
         if self.int8:
             from p2p_tpu.ops.int8 import QuantConv
@@ -107,7 +132,7 @@ class ConvLayer(nn.Module):
                 dtype=self.dtype, kernel_init=self.kernel_init,
                 name="Conv_0", delayed=self.int8_delayed,
             )(x)
-        if self.stride == 1 and in_c <= 8 and self.features >= 16:
+        if _thin_stem_eligible(x, self.features, self.stride):
             # thin-INPUT stems (RGB → ngf at full res, e.g. the pix2pixHD
             # enhancer's k7 stem): XLA's conv/wgrad collapse to
             # 0.5-0.6 TF/s at these shapes — one materialized patch
@@ -117,8 +142,7 @@ class ConvLayer(nn.Module):
                 use_bias=self.use_bias, dtype=self.dtype,
                 kernel_init=self.kernel_init, name="Conv_0",
             )(x)
-        if self.stride == 1 and (self.features * 16 <= in_c
-                                 or (self.features <= 4 and in_c >= 16)):
+        if _thin_head_eligible(x, self.features, self.stride):
             # thin image heads (e.g. the ResNet/Expand generators' k9→3
             # and the pix2pixHD enhancer's k7→3): XLA's conv runs the MXU
             # at ~4.5 TF/s with 3 of 128 output lanes live (profiled
@@ -512,10 +536,8 @@ class UpsampleConvLayer(nn.Module):
         if self.upsample:
             x = upsample_nearest(x, self.upsample)
         pad = self.kernel_size // 2
-        in_c = x.shape[-1]
         x = reflect_pad_2d(x, pad)
-        if self.stride == 1 and (self.features * 16 <= in_c
-                                 or (self.features <= 4 and in_c >= 16)):
+        if _thin_head_eligible(x, self.features, self.stride):
             # thin image heads (ExpandNetwork's k9→3 lives HERE, not in
             # ConvLayer — networks.py:518-520): same ThinHeadConv
             # dispatch as ConvLayer, same param tree (Conv_0)

@@ -536,13 +536,22 @@ def test_convlayer_thin_head_kn2row_equals_plain():
     plain VALID-conv path on the same params, fwd and grads."""
     import jax
 
-    from p2p_tpu.ops.conv import ConvLayer, reflect_pad_2d
+    from p2p_tpu.ops.conv import ThinHeadConv, reflect_pad_2d
     from flax import linen as nn
 
     rng = np.random.default_rng(7)
     x = jnp.asarray(rng.normal(size=(2, 12, 10, 64)), jnp.float32)
 
-    thin = ConvLayer(3, kernel_size=9)   # dispatches to ThinHeadConv
+    class Thin(nn.Module):
+        # the module ConvLayer dispatches to at >=300k-pixel extents
+        # (the spatial gate keeps test shapes on the plain path, so the
+        # dispatch target is exercised directly here)
+        @nn.compact
+        def __call__(self, x):
+            x = reflect_pad_2d(x, 4)
+            return ThinHeadConv(3, kernel_size=9, name="Conv_0")(x)
+
+    thin = Thin()
     v = thin.init(jax.random.key(0), x)
 
     class Plain(nn.Module):
@@ -579,12 +588,19 @@ def test_convlayer_thin_input_patches_equals_plain():
 
     from flax import linen as nn
 
-    from p2p_tpu.ops.conv import ConvLayer, reflect_pad_2d
+    from p2p_tpu.ops.conv import PatchesConv, reflect_pad_2d
 
     rng = np.random.default_rng(8)
     x = jnp.asarray(rng.normal(size=(2, 14, 12, 3)), jnp.float32)
 
-    stem = ConvLayer(16, kernel_size=7)  # dispatches to PatchesConv
+    class Stem(nn.Module):
+        # the module ConvLayer dispatches to at >=300k-pixel extents
+        @nn.compact
+        def __call__(self, x):
+            x = reflect_pad_2d(x, 3)
+            return PatchesConv(16, kernel_size=7, name="Conv_0")(x)
+
+    stem = Stem()
     v = stem.init(jax.random.key(0), x)
 
     class Plain(nn.Module):
@@ -604,3 +620,40 @@ def test_convlayer_thin_input_patches_equals_plain():
                     jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_thin_conv_dispatch_routing():
+    """The spatial gate routes as measured: >=300k-pixel thin shapes go to
+    the patches/kn2row forms (no conv_general_dilated in the jaxpr); small
+    shapes stay on the plain conv path. Abstract eval only — no compute."""
+    import jax
+
+    from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer
+
+    def jaxpr_of(layer, shape):
+        x = jnp.zeros(shape, jnp.float32)
+        v = jax.eval_shape(lambda: layer.init(jax.random.key(0), x))
+        # init abstractly, then trace apply with concrete-free params
+        v = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype),
+            layer.init(jax.random.key(0), jnp.zeros(
+                (1,) + shape[1:], jnp.float32)),
+        )
+        return str(jax.make_jaxpr(lambda p, xx: layer.apply(p, xx))(v, x))
+
+    # thin HEAD, big extent (600·512 = 307k > gate): kn2row path
+    big_head = jaxpr_of(ConvLayer(3, kernel_size=7), (1, 600, 512, 64))
+    assert "conv_general_dilated" not in big_head
+    # same layer, small extent: plain conv
+    small_head = jaxpr_of(ConvLayer(3, kernel_size=7), (1, 64, 64, 64))
+    assert "conv_general_dilated" in small_head
+
+    # thin STEM, big extent: patches path (dot_general, no conv)
+    big_stem = jaxpr_of(ConvLayer(32, kernel_size=7), (1, 600, 512, 3))
+    assert "conv_general_dilated" not in big_stem
+    small_stem = jaxpr_of(ConvLayer(32, kernel_size=7), (1, 64, 64, 3))
+    assert "conv_general_dilated" in small_stem
+
+    # UpsampleConvLayer shares the head predicate (Expand's k9→3)
+    big_up = jaxpr_of(UpsampleConvLayer(3, kernel_size=9), (1, 600, 512, 32))
+    assert "conv_general_dilated" not in big_up
