@@ -174,3 +174,56 @@ def test_video_trainer_end_to_end(tmp_path):
                        use_mesh=False)
     assert tr2.maybe_resume()
     assert int(tr2.state.step) == int(tr.state.step)
+
+
+def test_conv3d_split_time_stem_equals_plain_3d():
+    """_Conv3D's thin-input per-dt decomposition == the plain 3-D conv on
+    the same params (Conv_0 tree unchanged), fwd and both grads."""
+    import numpy as np
+    from flax import linen as nn
+
+    from p2p_tpu.models.temporal_d import _Conv3D
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 4, 12, 10, 6)), jnp.float32)
+
+    split = _Conv3D(16, stride_hw=2)   # cin=6 → _SplitTimeStem
+    v = split.init(jax.random.key(0), x)
+
+    class Plain(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Conv(16, kernel_size=(3, 4, 4), strides=(1, 2, 2),
+                           padding=((1, 1), (2, 2), (2, 2)),
+                           name="Conv_0")(x)
+
+    np.testing.assert_allclose(
+        np.asarray(split.apply(v, x)), np.asarray(Plain().apply(v, x)),
+        rtol=2e-5, atol=2e-5)
+
+    g1 = jax.grad(lambda xx: jnp.sum(jnp.sin(split.apply(v, xx))))(x)
+    g2 = jax.grad(lambda xx: jnp.sum(jnp.sin(Plain().apply(v, xx))))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-4, atol=2e-4)
+    gw1 = jax.grad(lambda vv: jnp.sum(jnp.sin(split.apply(vv, x))))(v)
+    gw2 = jax.grad(lambda vv: jnp.sum(jnp.sin(Plain().apply(vv, x))))(v)
+    for a, b in zip(jax.tree_util.tree_leaves(gw1),
+                    jax.tree_util.tree_leaves(gw2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+    # the SHIPPED dtype is bf16 (mixed_precision default): the f32-partials
+    # accumulation must keep the split within bf16 rounding of the plain
+    # bf16 conv
+    split16 = _Conv3D(16, stride_hw=2, dtype=jnp.bfloat16)
+
+    class Plain16(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Conv(16, kernel_size=(3, 4, 4), strides=(1, 2, 2),
+                           padding=((1, 1), (2, 2), (2, 2)),
+                           dtype=jnp.bfloat16, name="Conv_0")(x)
+
+    y16 = np.asarray(split16.apply(v, x.astype(jnp.bfloat16)), np.float32)
+    r16 = np.asarray(Plain16().apply(v, x.astype(jnp.bfloat16)), np.float32)
+    np.testing.assert_allclose(y16, r16, rtol=2e-2, atol=2e-2)
