@@ -110,17 +110,22 @@ def main() -> None:
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
     n_frames = cfg.data.n_frames
+    # BENCH_U8=0 opts out of the uint8 batch contract (default ON — the
+    # real pipeline ships uint8 and the steps normalize on device, so the
+    # HBM-resident scan batches are uint8 too: 4× less input read traffic
+    # per step; numerics pinned identical in tests/test_train.py)
+    bench_u8 = os.environ.get("BENCH_U8", "1") == "1"
     host = synthetic_batch(batch_size=bs * max(n_frames, 1), size=img,
-                           bits=cfg.model.quant_bits, width=wid)
+                           bits=cfg.model.quant_bits, width=wid,
+                           dtype="uint8" if bench_u8 else "float32")
     if n_frames > 1:
         # video presets: NTHWC clips through the video step (the img/s
         # figure counts FRAMES — the per-chip pixel-throughput analogue)
         host = {k: v.reshape(bs, n_frames, *v.shape[1:])
                 for k, v in host.items()}
-    single = {k: jnp.asarray(v, jnp.float32) for k, v in host.items()}
+    single = {k: jnp.asarray(v) for k, v in host.items()}
     batches = {
-        k: jnp.asarray(np.broadcast_to(v, (scan_k,) + v.shape).copy(),
-                       jnp.float32)
+        k: jnp.asarray(np.broadcast_to(v, (scan_k,) + v.shape).copy())
         for k, v in host.items()
     }
 

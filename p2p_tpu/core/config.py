@@ -156,6 +156,13 @@ class OptimConfig:
     # for per-sample-norm backward blowups on degenerate (near-constant)
     # images — see train/state.py:make_optimizers.
     grad_clip: float = 0.0
+    # Storage dtype for BOTH Adam moments (None = f32, reference parity;
+    # "bfloat16" halves the optimizer state's HBM footprint AND per-step
+    # traffic — the bs=1 facades budget is parameter/moment-traffic-bound,
+    # BASELINE.md round-4). Params stay f32 masters; the moment math runs
+    # in f32 and only the STORED moments round (train/state.py
+    # scale_by_adam_lp).
+    moment_dtype: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,6 +180,13 @@ class DataConfig:
     augment: bool = False
     # Video clips for vid2vid-style configs
     n_frames: int = 1
+    # uint8 input pipeline: the decode memo stores raw bytes (4× less host
+    # RAM than f32), H2D ships uint8 (4× less PCIe), and the train/eval
+    # steps normalize ON DEVICE — (f32(u8) − 127.5)·(1/127.5), the one
+    # canonical FMA-proof expression (utils/images.ingest), bit-exact with
+    # the host normalize — so this is a pure transport optimization
+    # (round-5 ledger row in BASELINE.md).
+    uint8_pipeline: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -31,26 +31,41 @@ from PIL import Image
 from p2p_tpu.data.generate import is_image_file
 
 
-def load_image(path: str, h: int, w: int) -> np.ndarray:
-    """Decode + resize-to-(h,w) + normalize to float32 [-1,1].
+def load_image(path: str, h: int, w: int,
+               as_uint8: bool = False) -> np.ndarray:
+    """Decode + resize-to-(h,w); float32 [-1,1] or raw uint8 [0,255].
 
     Native C++ fast path (p2p_tpu.native) for PNGs already at target size
     (header probe before any inflate work); PIL + bicubic resize otherwise.
-    Normalize(.5,.5,.5) semantics: x/127.5 - 1.
+    Normalize(.5,.5,.5) semantics: x/127.5 - 1. ``as_uint8`` returns the
+    decoded bytes instead — the uint8 input pipeline normalizes on device
+    (utils/images.ingest), bit-exact with the host normalize because both
+    round through the same f32 values.
     """
     from p2p_tpu import native
 
     fast = native.load_image_fast(path, expect_hw=(h, w))
     if fast is not None:
-        return fast[1]
+        return fast[0] if as_uint8 else fast[1]
     img = Image.open(path).convert("RGB")
     if img.size != (w, h):
         img = img.resize((w, h), Image.BICUBIC)
-    return np.asarray(img, np.float32) / 127.5 - 1.0
+    arr = np.asarray(img, np.uint8)
+    if as_uint8:
+        return arr
+    # the canonical normalize: (x − 127.5)·(1/127.5) — exact subtraction
+    # then ONE rounding multiply, and no mul+add pattern any backend can
+    # FMA-contract. Same expression as fastimage.cpp normalize_f32 and
+    # the device-side utils/images.ingest → all three bit-identical.
+    return ((arr.astype(np.float32) - np.float32(127.5))
+            * np.float32(1.0 / 127.5))
 
 
 class PairedImageDataset:
-    """Random-access paired dataset; items are dicts of float32 [-1,1] HWC."""
+    """Random-access paired dataset; items are dicts of HWC images —
+    float32 [-1,1] by default, raw uint8 [0,255] with ``dtype='uint8'``
+    (the uint8 input pipeline: smaller memo/PCIe, device-side normalize
+    via utils/images.ingest — numerically identical)."""
 
     def __init__(
         self,
@@ -62,6 +77,7 @@ class PairedImageDataset:
         augment: bool = False,
         aug_seed: int = 0,
         cache: Union[bool, str] = "auto",
+        dtype: str = "float32",
     ):
         self.a_dir = os.path.join(root, split, "a")
         self.b_dir = os.path.join(root, split, "b")
@@ -85,10 +101,14 @@ class PairedImageDataset:
         # cache when the decoded split fits comfortably (<4 GB). The memo
         # sits UPSTREAM of augmentation (scaled source images are cached,
         # crops/flips stay per-(seed, epoch, idx)).
+        if dtype not in ("float32", "uint8"):
+            raise ValueError(f"dtype must be float32|uint8, got {dtype!r}")
+        self.as_uint8 = dtype == "uint8"
         if cache == "auto":
             lh = (self.h * 286 // 256) if augment else self.h
             lw = (self.w * 286 // 256) if augment else self.w
-            cache = len(self.names) * lh * lw * 3 * 4 * 2 <= 4 << 30
+            bpp = 1 if self.as_uint8 else 4  # the uint8 memo is 4× smaller
+            cache = len(self.names) * lh * lw * 3 * bpp * 2 <= 4 << 30
         self.cache_enabled = bool(cache)
         self._memo: dict = {}
 
@@ -100,11 +120,11 @@ class PairedImageDataset:
         h = h or self.h
         w = w or self.w
         if not self.cache_enabled:
-            return load_image(path, h, w)
+            return load_image(path, h, w, self.as_uint8)
         key = (path, h, w)
         hit = self._memo.get(key)
         if hit is None:
-            hit = load_image(path, h, w)
+            hit = load_image(path, h, w, self.as_uint8)
             hit.setflags(write=False)
             self._memo[key] = hit
         return hit

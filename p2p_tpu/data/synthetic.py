@@ -70,9 +70,11 @@ def make_synthetic_dataset(
 
 def synthetic_batch(
     batch_size: int = 1, size: int = 64, bits: int = 3, seed: int = 0,
-    width: Optional[int] = None,
+    width: Optional[int] = None, dtype: str = "float32",
 ):
-    """In-memory batch dict {'input','target'} in [-1,1], b2a direction.
+    """In-memory batch dict {'input','target'}, b2a direction — float32
+    [-1,1] by default, raw uint8 with ``dtype='uint8'`` (the uint8 input
+    pipeline contract; the steps normalize on device via ingest).
 
     ``size`` is the height; ``width`` defaults to square (the wide presets —
     Cityscapes 512×256, pix2pixHD 1024×512 — pass it explicitly)."""
@@ -82,5 +84,10 @@ def synthetic_batch(
          for _ in range(batch_size)]
     )
     inputs = np.stack([compress_uint8(t, bits) for t in targets])
-    to_f = lambda x: x.astype(np.float32) / 127.5 - 1.0
+    if dtype == "uint8":
+        return {"input": inputs, "target": targets}
+    # the canonical normalize (see utils/images.ingest) so the f32 and
+    # uint8 synthetic batches are bit-identical after device ingest
+    to_f = lambda x: ((x.astype(np.float32) - np.float32(127.5))
+                      * np.float32(1.0 / 127.5))
     return {"input": to_f(inputs), "target": to_f(targets)}

@@ -3,8 +3,10 @@
 Layout: ``root/<split>/{a,b}/<video_id>/<frame>.png`` — per-video frame
 directories, paired by identical video-id + frame name (the video analogue
 of the reference's paired a/b folders, dataset.py:18-27). Items are
-consecutive ``n_frames`` windows as (T, H, W, C) float32 [-1,1] dicts; the
-batcher stacks them to NTHWC for the video train step.
+consecutive ``n_frames`` windows as (T, H, W, C) dicts — float32 [-1,1]
+by default, uint8 with ``dtype='uint8'`` (device-side normalize, see
+data/pipeline.py) — and the batcher stacks them to NTHWC for the video
+train step.
 
 Synthetic clips (moving discs over a gradient background, quantized b/
 stream) mirror data.synthetic for tests and benches.
@@ -33,7 +35,11 @@ class VideoClipDataset:
         image_width: Optional[int] = None,
         n_frames: int = 8,
         stride: Optional[int] = None,
+        dtype: str = "float32",
     ):
+        if dtype not in ("float32", "uint8"):
+            raise ValueError(f"dtype must be float32|uint8, got {dtype!r}")
+        self.as_uint8 = dtype == "uint8"
         self.a_dir = os.path.join(root, split, "a")
         self.b_dir = os.path.join(root, split, "b")
         self.direction = direction
@@ -62,7 +68,7 @@ class VideoClipDataset:
     def _load(self, path: str) -> np.ndarray:
         from p2p_tpu.data.pipeline import load_image
 
-        return load_image(path, self.h, self.w)
+        return load_image(path, self.h, self.w, self.as_uint8)
 
     def _clip(self, base: str, vid: str, frames: List[str]) -> np.ndarray:
         return np.stack(

@@ -143,8 +143,14 @@ int png_decode(const uint8_t* data, int64_t size, uint8_t* out,
 
 // uint8 HWC -> float32 in [-1,1]: x/127.5 - 1  (ToTensor + Normalize(.5))
 void normalize_f32(const uint8_t* src, float* dst, int64_t n) {
+    // (x - 127.5) * (1/127.5), NOT x*(1/127.5) - 1: the subtraction is
+    // exact in f32 (integer ± 127.5 needs 8 significand bits) so the
+    // expression has a single rounding step AND no mul+add pattern a
+    // compiler could contract into a differently-rounded FMA — the same
+    // canonical expression as data/pipeline.load_image and the device-
+    // side utils/images.ingest, keeping all three paths bit-identical.
     constexpr float k = 1.0f / 127.5f;
-    for (int64_t i = 0; i < n; ++i) dst[i] = src[i] * k - 1.0f;
+    for (int64_t i = 0; i < n; ++i) dst[i] = (src[i] - 127.5f) * k;
 }
 
 // ------------------------------------------------------------- quantize

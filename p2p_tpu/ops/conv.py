@@ -74,14 +74,23 @@ def save_conv_out(y: jax.Array) -> jax.Array:
 _THIN_DISPATCH_MIN_PIXELS = 300_000
 
 
-def _thin_head_eligible(x, features: int, stride: int) -> bool:
+def _thin_head_eligible(x, features: int, kernel_size: int,
+                        stride: int) -> bool:
     """Shared ConvLayer/UpsampleConvLayer predicate for the ThinHeadConv
-    dispatch (x is the PADDED input)."""
+    dispatch (x is the PADDED input).
+
+    The tap-channel bound ``F·k² ≤ 8·C_in`` keeps the dispatch inside the
+    measured-winning regime (HD k7 64→3: 147 ≤ 512; Expand k9 32→3:
+    243 ≤ 256) and excludes shapes like 16→4 at k7/k9 where the kn2row
+    tap tensor would carry 12-20× the input's channels at full res —
+    far outside anything profiled, risking a memory/perf regression for
+    small-ngf configs at big extents."""
     in_c = x.shape[-1]
     return (stride == 1
             and x.shape[1] * x.shape[2] >= _THIN_DISPATCH_MIN_PIXELS
             and (features * 16 <= in_c
-                 or (features <= 4 and in_c >= 16)))
+                 or (features <= 4 and in_c >= 16))
+            and features * kernel_size * kernel_size <= 8 * in_c)
 
 
 def _thin_stem_eligible(x, features: int, stride: int) -> bool:
@@ -142,7 +151,8 @@ class ConvLayer(nn.Module):
                 use_bias=self.use_bias, dtype=self.dtype,
                 kernel_init=self.kernel_init, name="Conv_0",
             )(x)
-        if _thin_head_eligible(x, self.features, self.stride):
+        if _thin_head_eligible(x, self.features, self.kernel_size,
+                               self.stride):
             # thin image heads (e.g. the ResNet/Expand generators' k9→3
             # and the pix2pixHD enhancer's k7→3): XLA's conv runs the MXU
             # at ~4.5 TF/s with 3 of 128 output lanes live (profiled
@@ -537,7 +547,8 @@ class UpsampleConvLayer(nn.Module):
             x = upsample_nearest(x, self.upsample)
         pad = self.kernel_size // 2
         x = reflect_pad_2d(x, pad)
-        if _thin_head_eligible(x, self.features, self.stride):
+        if _thin_head_eligible(x, self.features, self.kernel_size,
+                               self.stride):
             # thin image heads (ExpandNetwork's k9→3 lives HERE, not in
             # ConvLayer — networks.py:518-520): same ThinHeadConv
             # dispatch as ConvLayer, same param tree (Conv_0)

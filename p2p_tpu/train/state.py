@@ -81,6 +81,53 @@ def count_nonfinite(tree: Any) -> jax.Array:
     )
 
 
+def scale_by_adam_lp(b1: float, b2: float, eps: float,
+                     moment_dtype) -> optax.GradientTransformation:
+    """Adam whose BOTH moments are STORED in ``moment_dtype`` (bf16 on the
+    bs=1 path) while all arithmetic runs in f32.
+
+    ``optax.adam(mu_dtype=...)`` casts only the first moment; the round-4
+    bs=1 budget shows the binding constraint is per-step parameter+moment
+    HBM traffic (≈2.0–2.3 ms of a 4.91 ms step), and nu is half of the
+    moment share — so both get the treatment. The f32 compute keeps the
+    bias correction and rsqrt well-conditioned; only the stored state
+    rounds to bf16 (relative step-size error ~2⁻⁸, far below GAN training
+    noise — pinned against f32 Adam in tests/test_train.py)."""
+    mdt = jnp.dtype(moment_dtype)
+
+    def init(params):
+        z = lambda p: jnp.zeros_like(p, dtype=mdt)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(z, params),
+            nu=jax.tree_util.tree_map(z, params),
+        )
+
+    def update(updates, state, params=None):
+        del params
+        f32 = jnp.float32
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m.astype(f32) + (1 - b1) * g.astype(f32),
+            state.mu, updates)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v.astype(f32)
+            + (1 - b2) * jnp.square(g.astype(f32)),
+            state.nu, updates)
+        count = optax.safe_int32_increment(state.count)
+        bc1 = 1 - b1 ** count.astype(f32)
+        bc2 = 1 - b2 ** count.astype(f32)
+        out = jax.tree_util.tree_map(
+            lambda m, v, g: (
+                (m / bc1) / (jnp.sqrt(v / bc2) + eps)).astype(g.dtype),
+            mu, nu, updates)
+        cast = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: x.astype(mdt), t)
+        return out, optax.ScaleByAdamState(
+            count=count, mu=cast(mu), nu=cast(nu))
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_optimizers(cfg: Config, steps_per_epoch: int):
     """Three Adam optimizers with the reference hyperparameters
     (lr=2e-4, β=(0.5, 0.999) — train.py:241-243) on the configured schedule.
@@ -99,9 +146,18 @@ def make_optimizers(cfg: Config, steps_per_epoch: int):
         clip = cfg.optim.grad_clip
 
         def inner(learning_rate):
-            adam = optax.adam(
-                learning_rate, b1=cfg.optim.beta1, b2=cfg.optim.beta2
-            )
+            if cfg.optim.moment_dtype:
+                # bf16-stored moments (OptimConfig.moment_dtype): same
+                # update math in f32, half the optimizer-state traffic
+                adam = optax.chain(
+                    scale_by_adam_lp(cfg.optim.beta1, cfg.optim.beta2,
+                                     1e-8, cfg.optim.moment_dtype),
+                    optax.scale_by_learning_rate(learning_rate),
+                )
+            else:
+                adam = optax.adam(
+                    learning_rate, b1=cfg.optim.beta1, b2=cfg.optim.beta2
+                )
             if clip > 0:
                 # Non-finite grads must be zeroed BEFORE the clip: with
                 # an inf gradient clip_by_global_norm scales by
@@ -140,8 +196,13 @@ def create_train_state(
     opt_g, opt_d, opt_c = make_optimizers(cfg, steps_per_epoch)
 
     kg, kd, kc = jax.random.split(rng, 3)
-    x = jnp.asarray(sample_batch["input"])
-    pair = jnp.concatenate([x, jnp.asarray(sample_batch["target"])], axis=-1)
+    from p2p_tpu.utils.images import ingest
+
+    # uint8 samples (DataConfig.uint8_pipeline) normalize to f32 here so
+    # shape/dtype inference at init matches what the step's ingest feeds
+    x = ingest(jnp.asarray(sample_batch["input"]))
+    pair = jnp.concatenate(
+        [x, ingest(jnp.asarray(sample_batch["target"]))], axis=-1)
 
     vg = init_variables(g, kg, x, cfg.model.init_type, cfg.model.init_gain,
                         train=False)

@@ -69,6 +69,7 @@ from p2p_tpu.losses import (
 from p2p_tpu.ops.quantize import quantize, quantize_ste
 from p2p_tpu.ops.tv import total_variation_loss
 from p2p_tpu.train.state import TrainState, build_models, make_optimizers
+from p2p_tpu.utils.images import ingest
 
 
 def _concat_pair(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -168,11 +169,10 @@ def build_train_step(
         return out, {k: mut.get(k, {}) for k in d_colls}
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
-        real_a = batch["input"]
-        real_b = batch["target"]
-        if train_dtype is not None:
-            real_a = real_a.astype(train_dtype)
-            real_b = real_b.astype(train_dtype)
+        # uint8 batches (DataConfig.uint8_pipeline) normalize here — fused
+        # into the first conv's input read; bit-exact with host f32 input
+        real_a = ingest(batch["input"], train_dtype)
+        real_b = ingest(batch["target"], train_dtype)
 
         # ---- 1. compression pre-filter + quantizer ----------------------
         def compressed_fn(params_c):
@@ -501,11 +501,8 @@ def build_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
     bits = cfg.model.quant_bits
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
-        real_a = batch["input"]
-        real_b = batch["target"]
-        if train_dtype is not None:
-            real_a = real_a.astype(train_dtype)
-            real_b = real_b.astype(train_dtype)
+        real_a = ingest(batch["input"], train_dtype)
+        real_b = ingest(batch["target"], train_dtype)
         if cfg.model.use_compression_net:
             raw = c.apply(
                 {"params": state.params_c, "batch_stats": state.batch_stats_c},

@@ -25,6 +25,7 @@ from p2p_tpu.losses import psnr, ssim
 from p2p_tpu.models.vgg import load_vgg19_params
 from p2p_tpu.train.checkpoint import CheckpointManager
 from p2p_tpu.train.loop import MetricsLogger
+from p2p_tpu.utils.images import ingest
 from p2p_tpu.train.video_step import (
     build_video_models,
     build_video_train_step,
@@ -39,11 +40,8 @@ def build_video_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
     g, _, _ = build_video_models(cfg, train_dtype)
 
     def step(state, batch):
-        real_a = batch["input"]
-        real_b = batch["target"]
-        if train_dtype is not None:
-            real_a = real_a.astype(train_dtype)
-            real_b = real_b.astype(train_dtype)
+        real_a = ingest(batch["input"], train_dtype)
+        real_b = ingest(batch["target"], train_dtype)
         n, t = real_a.shape[0], real_a.shape[1]
         a_f = real_a.reshape((n * t,) + real_a.shape[2:])
         b_f = real_b.reshape((n * t,) + real_b.shape[2:])
@@ -77,6 +75,7 @@ class VideoTrainer:
         kw = dict(
             direction=cfg.data.direction, image_size=cfg.data.image_size,
             image_width=cfg.data.image_width, n_frames=cfg.data.n_frames,
+            dtype="uint8" if cfg.data.uint8_pipeline else "float32",
         )
         self.train_ds = VideoClipDataset(root, "train", **kw)
         self.test_ds = VideoClipDataset(root, "test", **kw)

@@ -33,6 +33,7 @@ from p2p_tpu.models.temporal_d import MultiscaleTemporalDiscriminator
 from p2p_tpu.ops.tv import total_variation_loss
 from p2p_tpu.train.state import make_optimizers
 from p2p_tpu.train.step import single_forward_d_losses
+from p2p_tpu.utils.images import ingest
 
 
 class VideoTrainState(struct.PyTreeNode):
@@ -90,8 +91,8 @@ def create_video_train_state(
     opt_g, opt_d, opt_dt = make_optimizers(cfg, steps_per_epoch)
 
     kg, kd, kt = jax.random.split(rng, 3)
-    x = jnp.asarray(sample_batch["input"])     # NTHWC
-    tgt = jnp.asarray(sample_batch["target"])
+    x = ingest(jnp.asarray(sample_batch["input"]))     # NTHWC
+    tgt = ingest(jnp.asarray(sample_batch["target"]))
     frames = _fold(x)
     pair_2d = jnp.concatenate([frames, _fold(tgt)], axis=-1)
     pair_3d = _clip_pair(x, tgt)
@@ -155,11 +156,9 @@ def build_video_train_step(
         return out, {"spectral": mut["spectral"]}
 
     def step(state: VideoTrainState, batch: Dict[str, jax.Array]):
-        real_a = batch["input"]    # NTHWC conditioning clip
-        real_b = batch["target"]   # NTHWC target clip
-        if train_dtype is not None:
-            real_a = real_a.astype(train_dtype)
-            real_b = real_b.astype(train_dtype)
+        # uint8 clips (DataConfig.uint8_pipeline) normalize on device
+        real_a = ingest(batch["input"], train_dtype)   # NTHWC conditioning
+        real_b = ingest(batch["target"], train_dtype)  # NTHWC target clip
         a_f = _fold(real_a)
         b_f = _fold(real_b)
 

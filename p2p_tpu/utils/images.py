@@ -12,6 +12,30 @@ import numpy as np
 from PIL import Image
 
 
+def ingest(x, train_dtype=None):
+    """Batch-image entry contract for the jitted steps: uint8 [0,255]
+    (the uint8 input pipeline, DataConfig.uint8_pipeline) or float [-1,1].
+
+    The device-side normalize ``(f32(u8) − 127.5)·(1/127.5)`` uses the
+    SAME f32 expression as both host decode paths (fastimage.cpp
+    normalize_f32 and data/pipeline.load_image): the subtraction is exact
+    in f32, leaving ONE rounding step and no mul+add pattern a backend
+    could FMA-contract — so the uint8 and f32 pipelines round through
+    identical f32 values on every backend. Verified bit-exact in
+    tests/test_train.py::test_train_step_uint8_batch_matches_f32; the
+    cast chain fuses into the first consumer under jit. Works on jax and
+    numpy arrays alike (returns jnp on jnp input).
+    """
+    import jax.numpy as jnp
+
+    if x.dtype == np.uint8:
+        x = ((x.astype(jnp.float32) - np.float32(127.5))
+             * np.float32(1.0 / 127.5))
+    if train_dtype is not None:
+        x = x.astype(train_dtype)
+    return x
+
+
 def to_uint8_img(x) -> np.ndarray:
     """[-1,1] float HWC → uint8 HWC. uint8 input passes through unscaled
     (already-converted images, e.g. the masking experiment's AND output)."""

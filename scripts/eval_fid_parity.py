@@ -74,14 +74,22 @@ def main(argv=None) -> int:
             "'draw' would be the same extractor. Drop --seeds (or unset "
             "P2P_TPU_VGG19_NPZ to test random-extractor robustness).")
 
-    # decode each directory ONCE; only the extractor changes per seed
-    batches = {}
-    for tag, path in (("gt", args.gt), ("torch", args.torch_preds),
-                      ("jax", args.jax_preds)):
-        batches[tag] = [
-            load_dir(path, names[i:i + args.batch], args.size)
-            for i in range(0, len(names), args.batch)
-        ]
+    dirs = {"gt": args.gt, "torch": args.torch_preds,
+            "jax": args.jax_preds}
+
+    def iter_batches(tag):
+        for i in range(0, len(names), args.batch):
+            yield load_dir(dirs[tag], names[i:i + args.batch], args.size)
+
+    # Multi-seed: decode each directory ONCE and reuse across seeds (only
+    # the extractor changes). Single-seed: STREAM the decode — holding all
+    # three directories in host RAM simultaneously can exhaust memory for
+    # large test sets at --size 512+.
+    if len(seeds) > 1:
+        batches = {tag: list(iter_batches(tag)) for tag in dirs}
+        get_batches = batches.__getitem__
+    else:
+        get_batches = iter_batches
 
     per_seed = {"torch": [], "jax": []}
     for seed in seeds:
@@ -90,7 +98,7 @@ def main(argv=None) -> int:
 
         def stats(tag):
             rs = RunningStats(1472)
-            for batch in batches[tag]:
+            for batch in get_batches(tag):
                 rs.update(feature_fn(jnp.asarray(batch)))
             return rs.finalize()
 

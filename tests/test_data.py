@@ -145,6 +145,40 @@ def test_paired_augmentation_deterministic_per_seed(tmp_path):
     assert ds[1]["input"].tobytes() == ds[1]["input"].tobytes()
 
 
+def test_uint8_pipeline_dataset_bit_exact(tmp_path):
+    """dtype='uint8' serves raw bytes; device-side normalize (ingest) is
+    BIT-EXACT with the f32 pipeline — both round through the same f32
+    values (the round-5 uint8 input pipeline, DataConfig.uint8_pipeline)."""
+    from p2p_tpu.utils.images import ingest
+
+    make_synthetic_dataset(str(tmp_path), n_train=3, n_test=1, size=32)
+    dsf = PairedImageDataset(str(tmp_path), image_size=32)
+    ds8 = PairedImageDataset(str(tmp_path), image_size=32, dtype="uint8")
+    for i in range(len(ds8)):
+        f, u = dsf[i], ds8[i]
+        for k in ("input", "target"):
+            assert u[k].dtype == np.uint8
+            np.testing.assert_array_equal(np.asarray(ingest(u[k])), f[k])
+    # the memo is byte-typed (the 4× host-RAM claim)
+    assert all(v.dtype == np.uint8 for v in ds8._memo.values())
+
+
+def test_uint8_pipeline_augmented_bit_exact(tmp_path):
+    """The augment path (crop/flip on the uint8 memo) commutes with the
+    normalize: identical crops, identical values after ingest."""
+    from p2p_tpu.utils.images import ingest
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=2, n_test=0, size=64)
+    kw = dict(direction="a2b", image_size=32, augment=True, aug_seed=4)
+    dsf = PairedImageDataset(root, "train", **kw)
+    ds8 = PairedImageDataset(root, "train", dtype="uint8", **kw)
+    for i in range(2):
+        f, u = dsf[i], ds8[i]
+        for k in ("input", "target"):
+            np.testing.assert_array_equal(np.asarray(ingest(u[k])), f[k])
+
+
 def test_device_prefetch_multiprocess_assembly_path(monkeypatch, tmp_path):
     """VERDICT r1 missing#5: on >1 JAX process the prefetcher must assemble
     global arrays with jax.make_array_from_process_local_data — device_put

@@ -135,6 +135,33 @@ def test_batch_norm_train_matches_torch():
     np.testing.assert_allclose(rm, np.asarray(tbn.running_mean), rtol=1e-4, atol=1e-5)
 
 
+def test_pallas_dual_moments_matches_xla_path():
+    """The single-pass Pallas BN stats kernel (interpret mode on CPU)
+    matches the variadic-reduce XLA path of ops/norm.dual_moments, in
+    bf16 and f32, including non-trivial grid accumulation (M/block > 2),
+    and its block picker stays inside divisors of M."""
+    from p2p_tpu.ops.norm import dual_moments
+    from p2p_tpu.ops.pallas.batch_moments import (
+        _pick_m_block,
+        pallas_dual_moments,
+    )
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        x = jnp.asarray(rng(4, 16, 8, 24), dtype)   # M = 512 rows, C = 24
+        x2d = x.reshape(-1, x.shape[-1])
+        s1, s2 = pallas_dual_moments(x2d, block_m=128, interpret=True)
+        r1, r2 = dual_moments(x)
+        # different (both-valid) f32 accumulation orders: block-partials
+        # in the kernel vs XLA's tree reduce
+        tol = dict(rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(r1), **tol)
+        np.testing.assert_allclose(np.asarray(s2), np.asarray(r2), **tol)
+
+    for m in (512, 768, 12 * 97):
+        mb = _pick_m_block(m, 64)
+        assert m % mb == 0 and mb >= 1
+
+
 # ----------------------------------------------------------- spectral norm
 def test_spectral_normalize_converges_to_top_singular_value():
     w = jnp.asarray(rng(8, 20))

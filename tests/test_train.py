@@ -142,6 +142,90 @@ def test_train_step_runs_and_updates_everything(batch):
 
 
 @pytest.mark.slow
+def test_train_step_uint8_batch_matches_f32():
+    """The uint8 batch contract (device-side ingest at step entry) matches
+    the f32 pipeline: the normalized INPUT is bit-exact (same canonical
+    f32 expression), and one full train step agrees at the 1-ulp level —
+    the residual comes from XLA fusing the convert chain differently in
+    the two compiled programs (measured: two reduced scalar metrics off by
+    6e-8, params by 2e-8), not from the normalize. Eval is bit-exact."""
+    from p2p_tpu.train.step import build_eval_step
+    from p2p_tpu.utils.images import ingest
+
+    rng = np.random.default_rng(42)
+    u8 = {k: rng.integers(0, 256, (2, 32, 32, 3)).astype(np.uint8)
+          for k in ("input", "target")}
+    # the canonical normalize expression — (x − 127.5)·(1/127.5), what
+    # load_image, fastimage.cpp and ingest all compute (FMA-proof form)
+    f32 = {k: (v.astype(np.float32) - np.float32(127.5))
+           * np.float32(1.0 / 127.5) for k, v in u8.items()}
+    for k in u8:  # the ingest contract itself is bit-exact, jit or not
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(ingest)(jnp.asarray(u8[k]))), f32[k])
+
+    cfg = tiny_config()
+    step_fn = build_train_step(cfg, None, 1, None, jit=True)
+    out = {}
+    for tag, b in (("u8", u8), ("f32", f32)):
+        state = create_train_state(cfg, jax.random.key(0), b, 1)
+        s1, m = step_fn(state, {k: jnp.asarray(v) for k, v in b.items()})
+        out[tag] = (s1, m)
+    for k in out["f32"][1]:
+        np.testing.assert_allclose(
+            np.asarray(out["u8"][1][k]), np.asarray(out["f32"][1][k]),
+            rtol=0, atol=1e-6, err_msg=k)
+    for a, b in zip(jax.tree_util.tree_leaves(out["u8"][0].params_g),
+                    jax.tree_util.tree_leaves(out["f32"][0].params_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=1e-6)
+
+    eval_fn = build_eval_step(cfg, None)
+    state = create_train_state(cfg, jax.random.key(0), u8, 1)
+    p8, m8 = eval_fn(state, {k: jnp.asarray(v) for k, v in u8.items()})
+    pf, mf = eval_fn(state, {k: jnp.asarray(v) for k, v in f32.items()})
+    np.testing.assert_array_equal(np.asarray(p8), np.asarray(pf))
+    np.testing.assert_array_equal(np.asarray(m8["psnr"]),
+                                  np.asarray(mf["psnr"]))
+
+
+def test_scale_by_adam_lp_matches_f32_adam():
+    """scale_by_adam_lp (bf16-stored moments, OptimConfig.moment_dtype):
+    with float32 storage it reproduces optax.adam's trajectory exactly
+    (same math, storage cast is a no-op); with bfloat16 storage it tracks
+    within bf16 rounding over multiple steps."""
+    import optax
+
+    from p2p_tpu.train.state import scale_by_adam_lp
+
+    params = {"w": jnp.asarray(np.random.default_rng(0)
+                               .standard_normal((16, 16)), jnp.float32)}
+    g_rng = np.random.default_rng(1)
+
+    def run(opt):
+        p = params
+        st = opt.init(p)
+        for _ in range(5):
+            g = {"w": jnp.asarray(g_rng.standard_normal((16, 16)) * 0.1,
+                                  jnp.float32)}
+            up, st = opt.update(g, st, p)
+            p = optax.apply_updates(p, up)
+        return p
+
+    lr = 1e-3
+    ref = run(optax.adam(lr, b1=0.5, b2=0.999))
+    g_rng = np.random.default_rng(1)
+    lp32 = run(optax.chain(scale_by_adam_lp(0.5, 0.999, 1e-8, "float32"),
+                           optax.scale_by_learning_rate(lr)))
+    np.testing.assert_allclose(np.asarray(lp32["w"]), np.asarray(ref["w"]),
+                               rtol=1e-6, atol=1e-8)
+    g_rng = np.random.default_rng(1)
+    lp16 = run(optax.chain(scale_by_adam_lp(0.5, 0.999, 1e-8, "bfloat16"),
+                           optax.scale_by_learning_rate(lr)))
+    # moments round to bf16 between steps: trajectories agree to ~2⁻⁸
+    np.testing.assert_allclose(np.asarray(lp16["w"]), np.asarray(ref["w"]),
+                               rtol=0, atol=2e-4)
+
+
 def test_train_step_no_compression_pix2pix(batch):
     cfg = tiny_config(use_compression_net=False, use_spectral_norm=False)
     cfg = Config(
