@@ -97,6 +97,19 @@ def main() -> None:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, thin_head=True, head_pallas=True))
         preset = preset.removesuffix("_th") + "_hp"
+    if os.environ.get("BENCH_SPLITD", ""):
+        # feed D unconcatenated (a,b) pairs (ModelConfig.split_d_pairs) —
+        # BENCH_SPLITD=0 forces concat on presets that default split
+        split_on = os.environ["BENCH_SPLITD"] == "1"
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, split_d_pairs=split_on))
+        preset = preset + ("_splitd" if split_on else "_concatd")
+    if os.environ.get("BENCH_MOM", ""):
+        # low-precision Adam moment storage (OptimConfig.moment_dtype),
+        # e.g. BENCH_MOM=bfloat16 — the bs=1 parameter-traffic lever
+        cfg = cfg.replace(optim=dataclasses.replace(
+            cfg.optim, moment_dtype=os.environ["BENCH_MOM"]))
+        preset = preset + "_mom16"
     if os.environ.get("BENCH_UPSAMPLE", ""):
         # override the U-Net decoder upsample family (deconv|subpixel|resize)
         cfg = cfg.replace(model=dataclasses.replace(

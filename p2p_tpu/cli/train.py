@@ -92,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="lambda|step|plateau|cosine")
     p.add_argument("--lr_decay_iters", type=int, default=None)
     p.add_argument("--beta1", type=float, default=None)
+    p.add_argument("--moment_dtype", type=str, default=None,
+                   help="Adam moment STORAGE dtype (e.g. bfloat16): halves "
+                        "optimizer-state HBM traffic, update math stays f32 "
+                        "(train/state.py scale_by_adam_lp)")
     p.add_argument("--threads", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--lamb", type=float, default=None,
@@ -169,7 +173,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     optim = over(optim, lr=args.lr, lr_policy=args.lr_policy,
                  lr_decay_iters=args.lr_decay_iters, beta1=args.beta1,
                  niter=args.niter, niter_decay=args.niter_decay,
-                 grad_clip=args.grad_clip)
+                 grad_clip=args.grad_clip, moment_dtype=args.moment_dtype)
     data = over(data, dataset=args.dataset, direction=args.direction,
                 batch_size=args.batch_size, image_size=args.image_size,
                 image_width=args.image_width,

@@ -110,6 +110,14 @@ class ModelConfig:
     # kernel (ops/pallas/subpixel_head.py — x read once per sample
     # block, tap matmuls accumulated in VMEM) instead of the XLA conv.
     head_pallas: bool = False
+    # Feed D the UNCONCATENATED (a, b) conditional pair (the split-stem
+    # form, models/patchgan._SplitStemConv): no materialized 6-channel
+    # full-res pair tensors, conv(a, W_a) CSE-shared across the fake/real
+    # branches. MEASURED shape-dependent: loses at 256²/bs128 (1661 vs
+    # 1701 — the concat was already fused into the stem's window gather)
+    # but the pair tensors at 1024×512 run at 26 GB/s in the round-4
+    # profile, so the HD preset flips it on (round-5 ledger).
+    split_d_pairs: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -295,6 +303,12 @@ _register(
         loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
                         lambda_l1=100.0),
         data=DataConfig(dataset="facades", image_size=256, batch_size=1),
+        # bf16-stored Adam moments (round-5 ledger): bs=1 204→228 img/s
+        # (the parameter/moment-traffic-bound path), ≥neutral at bs=128
+        # (1716.0); quality pinned by metrics_mom16_q.jsonl (e9 peak
+        # 22.6 PSNR on the 10-epoch decayed real256 protocol) and the
+        # optax-trajectory unit test.
+        optim=OptimConfig(moment_dtype="bfloat16"),
         parallel=ParallelConfig(mesh=MeshSpec(data=1)),
     )
 )
@@ -342,8 +356,13 @@ _register(
 _register(
     Config(
         name="pix2pixhd",
+        # split_d_pairs: at 1024×512 the materialized 6-ch pair tensors
+        # run at 26 GB/s (round-4 profile); the split-stem form measures
+        # 8.76 vs 8.65 img/s (round-5 ledger). With the _NearestUp2Conv
+        # subpixel dispatch (+7.5%) the preset is 8.05 → 8.76 overall.
         model=ModelConfig(generator="pix2pixhd", ngf=64, norm="pallas_instance",
-                          num_D=3, n_layers_D=3, use_compression_net=False),
+                          num_D=3, n_layers_D=3, use_compression_net=False,
+                          split_d_pairs=True),
         loss=LossConfig(lambda_feat=10.0, lambda_vgg=10.0, lambda_tv=0.0),
         data=DataConfig(dataset="cityscapes_hd", image_size=512,
                         image_width=1024, batch_size=1),

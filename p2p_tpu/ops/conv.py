@@ -12,11 +12,13 @@ same choice the reference made to avoid checkerboard artifacts.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 from jax.ad_checkpoint import checkpoint_name
 
@@ -529,6 +531,78 @@ class SubpixelDeconv(nn.Module):
         return subpixel_interleave(out, self.features)
 
 
+def depth_to_space_2x(out: jax.Array, features: int) -> jax.Array:
+    """Plain ×2 depth-to-space: (N,H,W,4F) → (N,2H,2W,F) with phase (u,v)
+    at channel block u·2+v — ``y[2i+u, 2j+v] = out[i, j, (u·2+v)·F:]``."""
+    n, h, w, _ = out.shape
+    out = out.reshape(n, h, w, 2, 2, features)
+    out = out.transpose(0, 1, 3, 2, 4, 5)
+    return out.reshape(n, 2 * h, 2 * w, features)
+
+
+class _NearestUp2Conv(nn.Module):
+    """EXACT subpixel decomposition of UpsampleConvLayer's
+    (nearest ×2 upsample → ReflectionPad(1) → 3×3 conv) chain.
+
+    With ``up(x)[p,q] = x[p//2, q//2]``, each output phase (u,v)∈{0,1}²
+    reads low-res offsets ``o = floor((u+a)/2)`` per tap a∈{-1,0,1}, so
+
+        out[2i+u, 2j+v] = Σ_{o_r,o_c} Wp[u,v][o_r,o_c] · x[i+o_r, j+o_c]
+
+    where the phase kernels Wp are pairwise sums of the original taps
+    (e.g. u=0 rows: [W₋₁, W₀+W₁]). All four phases fit a 3×3 support on
+    the LOW-RES grid, so the whole layer is ONE 3×3 conv ci→4·co at half
+    resolution + :func:`depth_to_space_2x`: the same FLOPs land on full
+    128-lane MXU tiles (vs a 32-lane-wide conv over the 4×-materialized
+    upsampled tensor) and the activation traffic drops ~4× — the
+    round-4 profile has this layer at 4.2 TF/s / ~4.7 ms of the
+    pix2pixHD step (BASELINE.md). Boundary: reflect-padding the UPSAMPLED
+    image equals EDGE-padding the low-res input for the single ring a 3×3
+    needs (up[-1]=up[0]=x[0], up[2H]=up[2H-2]=x[H-1]); k≥5 needs a second
+    ring where that identity breaks — hence the k==3 gate in the
+    dispatcher. Param tree identical to ``nn.Conv`` ("kernel" (3,3,ci,co)
+    [+ "bias"]), so checkpoints and the TP sharding rules are unchanged.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = normal_init()
+
+    @nn.compact
+    def __call__(self, x):
+        ci, co = x.shape[-1], self.features
+        kernel = self.param("kernel", self.kernel_init, (3, 3, ci, co),
+                            jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros, (co,), jnp.float32)
+                if self.use_bias else None)
+        # M[u, o+1, a+1] = 1 where floor((u+a)/2) == o — the tap→offset
+        # folding matrix (constant, folded into the weights at trace time)
+        m = np.zeros((2, 3, 3), np.float32)
+        for u in (0, 1):
+            for ia, a in enumerate((-1, 0, 1)):
+                m[u, (u + a) // 2 + 1, ia] = 1.0
+        m = jnp.asarray(m)
+        # Wc[r,c,i,(u,v,o)] = Σ_{a,b} M[u,r,a]·M[v,c,b]·W[a,b,i,o]
+        wc = jnp.einsum("ura,vcb,abio->rciuvo", m, m, kernel)
+        wc = wc.reshape(3, 3, ci, 4 * co)
+        # house convention for dispatch targets (cf. _SplitStemConv):
+        # dtype=None computes in f32, keeping the P2P_UP2SP A/B
+        # numerically comparable with the plain nn.Conv path
+        dt = self.dtype or jnp.float32
+        xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), mode="edge")
+        y = jax.lax.conv_general_dilated(
+            xp.astype(dt), wc.astype(dt), window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = save_conv_out(y)
+        y = depth_to_space_2x(y, co)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y
+
+
 class UpsampleConvLayer(nn.Module):
     """Optional nearest ×upsample → ReflectionPad → conv.
     Ref: networks.py:408-423."""
@@ -543,6 +617,17 @@ class UpsampleConvLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        if (self.upsample == 2 and self.kernel_size == 3 and self.stride == 1
+                and 4 * x.shape[1] * x.shape[2] >= _THIN_DISPATCH_MIN_PIXELS
+                and os.environ.get("P2P_UP2SP", "1") == "1"):
+            # subpixel decomposition of upsample→conv at big extents (the
+            # pix2pixHD enhancer's 64→32 at 1024×512 — see _NearestUp2Conv;
+            # gated on the POST-upsample extent with the same constant as
+            # the thin dispatches; P2P_UP2SP=0 opts out for A/B measurement)
+            return _NearestUp2Conv(
+                self.features, use_bias=self.use_bias, dtype=self.dtype,
+                kernel_init=self.kernel_init, name="Conv_0",
+            )(x)
         if self.upsample:
             x = upsample_nearest(x, self.upsample)
         pad = self.kernel_size // 2

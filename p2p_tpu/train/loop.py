@@ -62,6 +62,69 @@ class MetricsLogger:
             self._f.close()
 
 
+def local_metric_rows(vec) -> np.ndarray:
+    """Process-local entries of a per-image (or per-frame) metric vector.
+
+    On one process the global array is fully addressable; on >1 only this
+    process's rows are — np.asarray would raise — so gather the
+    addressable shards in row order (this process's own images, because
+    the loader fed exactly those rows of the global batch).
+
+    On a mesh with axes beyond 'data' (data×spatial, data×time) the
+    vector is REPLICATED over the extra axes, so each row range appears
+    once per replica among the addressable shards — concatenating them
+    all would duplicate head rows and the later [:n_real] trim would drop
+    real tail entries. Keep exactly one shard per distinct row range.
+    Shared by Trainer.evaluate and VideoTrainer.evaluate."""
+    if jax.process_count() == 1:
+        return np.asarray(vec).ravel()
+    by_start = {}
+    for s in vec.addressable_shards:
+        start = s.index[0].start or 0
+        if start not in by_start:
+            by_start[start] = s
+    parts = [by_start[k] for k in sorted(by_start)]
+    out = np.concatenate([np.asarray(p.data).ravel() for p in parts])
+    # the kept shards must tile this process's rows WITHOUT overlap — a
+    # future mesh layout producing overlapping slices with distinct
+    # starts (e.g. [0,4) and [2,6)) would double-count rows the
+    # dedup-by-start cannot see
+    prev_stop = None
+    for p in parts:
+        start = p.index[0].start or 0
+        if prev_stop is not None:
+            assert start >= prev_stop, (
+                "overlapping metric shards", start, prev_stop)
+        prev_stop = p.index[0].stop or vec.shape[0]
+    return out
+
+
+def combine_process_metric_stats(psnrs, ssims):
+    """Cross-process reduction of per-process metric lists into global
+    (psnr_mean, psnr_max, ssim_mean, ssim_max, n_total).
+
+    Fixed-size allgather of (sum, max, count) — the per-image vectors have
+    process-dependent lengths. A process whose shard dropped to zero
+    batches (tiny split) must STILL enter the collective with empty-safe
+    stats, or the others hang forever. Shared by both trainers."""
+    from jax.experimental import multihost_utils
+
+    stats = np.array(
+        [np.sum(psnrs), np.max(psnrs, initial=-np.inf), len(psnrs),
+         np.sum(ssims), np.max(ssims, initial=-np.inf)], np.float64,
+    )
+    g = np.asarray(multihost_utils.process_allgather(stats))
+    n_total = g[:, 2].sum()
+    if n_total == 0:
+        raise RuntimeError(
+            "multi-host eval scored 0 images: the test split is "
+            "smaller than process_count × test batch — shrink "
+            "test_batch_size or add test data")
+    return (float(g[:, 0].sum() / n_total), float(g[:, 1].max()),
+            float(g[:, 3].sum() / n_total), float(g[:, 4].max()),
+            int(n_total))
+
+
 class Trainer:
     def __init__(
         self,
@@ -420,38 +483,7 @@ class Trainer:
         shards = int(self.mesh.shape["data"]) if self.mesh is not None else 1
         n_proc = jax.process_count()
 
-        def metric_local(vec):
-            """Process-local entries of a per-image metric vector. On one
-            process the global array is fully addressable; on >1 only this
-            process's rows are — np.asarray would raise — so gather the
-            addressable shards in row order (this process's own images,
-            because the loader fed exactly those rows of the global batch).
-
-            On a mesh with axes beyond 'data' (data×spatial, data×time) the
-            per-image vector is REPLICATED over the extra axes, so each row
-            range appears once per replica among the addressable shards —
-            concatenating them all would duplicate head rows and the later
-            [:n_real] trim would drop real tail images. Keep exactly one
-            shard per distinct row range."""
-            if n_proc == 1:
-                return np.asarray(vec).ravel()
-            by_start = {}
-            for s in vec.addressable_shards:
-                start = s.index[0].start or 0
-                if start not in by_start:
-                    by_start[start] = s
-            parts = [by_start[k] for k in sorted(by_start)]
-            out = np.concatenate(
-                [np.asarray(p.data).ravel() for p in parts])
-            # length must equal this process's distinct row count (the
-            # union of the unique slice extents) — catches any residual
-            # double-count if a future mesh layout splits rows differently
-            n_local = sum(
-                (p.index[0].stop or vec.shape[0]) - (p.index[0].start or 0)
-                for p in parts
-            )
-            assert out.shape[0] == n_local, (out.shape, n_local)
-            return out
+        metric_local = local_metric_rows  # module-level, shared with video
 
         def padded(it):
             for b in it:
@@ -520,31 +552,15 @@ class Trainer:
                             out_dir, f"e{self.epoch}_mask.png"))
                 sample_saved = True
         if n_proc > 1:
-            # each process scored its OWN shard of the test split; combine
-            # with a fixed-size allgather of (sum, max, count) — the
-            # per-image vectors have process-dependent lengths. A process
-            # whose shard dropped to zero batches (tiny split) must STILL
-            # enter the collective with empty-safe stats, or the others
-            # hang forever.
-            from jax.experimental import multihost_utils
-
-            stats = np.array(
-                [np.sum(psnrs), np.max(psnrs, initial=-np.inf), len(psnrs),
-                 np.sum(ssims), np.max(ssims, initial=-np.inf)], np.float64,
-            )
-            g = np.asarray(multihost_utils.process_allgather(stats))
-            n_total = g[:, 2].sum()
-            if n_total == 0:
-                raise RuntimeError(
-                    "multi-host eval scored 0 images: the test split is "
-                    "smaller than process_count × test batch — shrink "
-                    "test_batch_size or add test data")
+            # each process scored its OWN shard of the test split
+            pm, px, sm, sx, n_total = combine_process_metric_stats(
+                psnrs, ssims)
             result = {
-                "psnr_mean": float(g[:, 0].sum() / n_total),
-                "psnr_max": float(g[:, 1].max()),
-                "ssim_mean": float(g[:, 3].sum() / n_total),
-                "ssim_max": float(g[:, 4].max()),
-                "n_images": int(n_total),
+                "psnr_mean": pm,
+                "psnr_max": px,
+                "ssim_mean": sm,
+                "ssim_max": sx,
+                "n_images": n_total,
             }
         else:
             result = {

@@ -137,6 +137,14 @@ def build_train_step(
     need_vgg = (L.lambda_vgg > 0) and vgg_params is not None
 
     use_dropout = cfg.model.use_dropout
+    if cfg.model.split_d_pairs and cfg.train.pool_size > 0:
+        # the historical-fake pool stores CONCATENATED pairs (its ring
+        # buffer holds one 6-ch tensor per slot), so the split-stem form
+        # cannot apply on the pool path — fail loudly rather than
+        # silently losing the HD optimization the flag promises
+        raise ValueError(
+            "split_d_pairs is incompatible with pool_size > 0 (the fake "
+            "pool stores concatenated pairs); set one of them off")
 
     # NOTE on residual policy: wrapping these forwards in jax.checkpoint
     # with save_only_these_names('conv_out', 'norm_stats') was measured
@@ -302,30 +310,40 @@ def build_train_step(
             dvars0 = {"spectral": state.spectral_d}
             if use_quant:
                 dvars0["quant"] = state.quant_d
-            # Concat pairs, NOT the split-stem (a, b) form: feeding D the
-            # unconcatenated halves (models/patchgan._SplitStemConv — no
-            # 6-ch pair tensors, CSE-shared conv(real_a, W_a), structurally
-            # dead real_a dgrad) MEASURED SLOWER on v5e: 1661 vs 1701
-            # img/s at 256²/bs128 — two 3-ch stem convs tile the MXU's
-            # contraction dim worse (2×48-wide im2col vs one 96-wide) and
-            # the concat was already fused into the stem's window gather.
-            # The split path stays op-level (pinned by
-            # tests/test_models.py::test_split_stem_pair_path_equals_concat).
+            # Pair form is MEASURED shape-dependent (ModelConfig.
+            # split_d_pairs): concat wins at 256²/bs128 (1661 vs 1701 —
+            # two 3-ch stem convs tile the MXU's contraction dim worse,
+            # 2×48-wide im2col vs one 96-wide, and the concat was already
+            # fused into the stem's window gather); the split-stem (a, b)
+            # form (models/patchgan._SplitStemConv — no materialized 6-ch
+            # pair tensors, CSE-shared conv(real_a, W_a), structurally
+            # dead real_a dgrad) wins at HD extents where the round-4
+            # profile has the pair tensors at 26 GB/s. Equivalence pinned
+            # by tests/test_models.py::test_split_stem_pair_path_equals
+            # _concat; both branches share single_forward_d_losses (the
+            # pair is a pytree either way).
+            split = cfg.model.split_d_pairs
             in_c = real_a.shape[-1]
+            if split:
+                fake_pair = (real_a, fake_b_primal)
+                real_pair = (real_a, real_b)
+            else:
+                fake_pair = _concat_pair(real_a, fake_b_primal)
+                real_pair = _concat_pair(real_a, real_b)
             loss_d, grads_d, pred_fake, pred_real, dvars2, pull = (
                 single_forward_d_losses(
                     d_fwd, dvars0, state.params_d,
-                    _concat_pair(real_a, fake_b_primal),
-                    _concat_pair(real_a, real_b),
-                    L.gan_mode,
+                    fake_pair, real_pair, L.gan_mode,
                 )
             )
 
             (loss_g, g_parts), (ct_fake_direct, ct_pred) = jax.value_and_grad(
                 g_losses, argnums=(0, 1), has_aux=True
             )(fake_b_primal, pred_fake)
-            # params cotangent dead (reference zero_grad) → DCE
-            grad_fake = ct_fake_direct + pull(ct_pred)[..., in_c:]
+            # params cotangent dead (reference zero_grad) → DCE; on the
+            # split path the pair cotangent is already the (a, b) tuple
+            grad_fake = ct_fake_direct + (
+                pull(ct_pred)[1] if split else pull(ct_pred)[..., in_c:])
         else:
             # Pool active: D's fake pair is the pooled history, not the live
             # fake — the forwards genuinely differ, keep the reference's

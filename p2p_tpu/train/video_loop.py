@@ -286,23 +286,40 @@ class VideoTrainer:
                 yield b, n
 
         t = cfg.data.n_frames
+        # per-frame metric vectors: process-local rows with replica dedup
+        # (the vector is replicated over the time axis of a data×time
+        # mesh) — shared machinery with the image Trainer
+        from p2p_tpu.train.loop import (
+            combine_process_metric_stats,
+            local_metric_rows,
+        )
+
         for batch, n_real in device_prefetch(
             padded(loader), self.clip_sharding, with_aux=True
         ):
             _, metrics = self.eval_step(self.state, batch)
             psnrs.extend(
-                np.asarray(metrics["psnr"]).ravel()[: n_real * t].tolist()
+                local_metric_rows(metrics["psnr"])[: n_real * t].tolist()
             )
             ssims.extend(
-                np.asarray(metrics["ssim"]).ravel()[: n_real * t].tolist()
+                local_metric_rows(metrics["ssim"])[: n_real * t].tolist()
             )
-        result = {
-            "psnr_mean": float(np.mean(psnrs)),
-            "psnr_max": float(np.max(psnrs)),
-            "ssim_mean": float(np.mean(ssims)),
-            "ssim_max": float(np.max(ssims)),
-            "n_frames_scored": len(psnrs),
-        }
+        if jax.process_count() > 1:
+            pm, px, sm, sx, n_total = combine_process_metric_stats(
+                psnrs, ssims)
+            result = {
+                "psnr_mean": pm, "psnr_max": px,
+                "ssim_mean": sm, "ssim_max": sx,
+                "n_frames_scored": n_total,
+            }
+        else:
+            result = {
+                "psnr_mean": float(np.mean(psnrs)),
+                "psnr_max": float(np.max(psnrs)),
+                "ssim_mean": float(np.mean(ssims)),
+                "ssim_max": float(np.max(ssims)),
+                "n_frames_scored": len(psnrs),
+            }
         self.logger.log({"kind": "eval", "epoch": self.epoch, **result})
         return result
 

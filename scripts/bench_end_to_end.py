@@ -56,9 +56,12 @@ def main(argv=None) -> int:
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
     K, bs = args.scan, args.bs
 
-    ds = PairedImageDataset(args.data, "train", cfg.data.direction, args.size)
+    # uint8 end to end: memo, HBM-resident split, and per-step gathers all
+    # carry raw bytes; the step normalizes on device (DataConfig default)
+    ds = PairedImageDataset(args.data, "train", cfg.data.direction, args.size,
+                            dtype="uint8")
     n = len(ds)
-    print(f"{n} real pairs; cache={ds.cache_enabled}")
+    print(f"{n} real pairs; cache={ds.cache_enabled} dtype=uint8")
 
     sample = {k: np.broadcast_to(v, (bs,) + v.shape).copy()
               for k, v in ds[0].items()}

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 measurement session 1: uint8 headline placement, Pallas BN,
+# bs=1 bf16 moments, real-data end-to-end. Serialized (1-vCPU host).
+cd /root/repo
+log=/root/repo/profiles/r5_session1.log
+mkdir -p profiles
+: > "$log"
+run() {
+  echo "=== $* ===" >> "$log"
+  ( "$@" ) >> "$log" 2>&1
+  echo "" >> "$log"
+}
+# 1-2. driver-default (uint8 batches) twice
+run python bench.py
+run python bench.py
+# 3. f32 opt-out pair for the ledger
+run env BENCH_U8=0 python bench.py
+# 4. Pallas BN single-pass stats
+run env P2P_PALLAS_BN=1 python bench.py
+# 5. bs=1 baseline + bf16 moments
+run env BENCH_BS=1 BENCH_SCAN=64 BENCH_STEPS=512 python bench.py
+run env BENCH_BS=1 BENCH_SCAN=64 BENCH_STEPS=512 BENCH_MOM=bfloat16 python bench.py
+# 6. real-data end-to-end at the headline shape
+run python scripts/bench_end_to_end.py --data dataset/real256 --bs 128 --preset facades_int8
+echo ALL_DONE >> "$log"

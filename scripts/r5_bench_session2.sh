@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-5 measurement session 2: bf16 moments at the headline batch,
+# pix2pixhd subpixel-upconv A/B, short real-data quality run with bf16
+# moments (the bs=1 flagship path's quality pin).
+cd /root/repo
+log=/root/repo/profiles/r5_session2.log
+: > "$log"
+run() {
+  echo "=== $* ===" >> "$log"
+  ( "$@" ) >> "$log" 2>&1
+  echo "" >> "$log"
+}
+# 1. headline bs=128 with bf16 moments (A/B vs session-1 default runs)
+run env BENCH_MOM=bfloat16 python bench.py
+# 2. pix2pixhd at native dims: subpixel up-conv ON (default) vs OFF
+run env BENCH_PRESET=pix2pixhd python bench.py
+run env BENCH_PRESET=pix2pixhd P2P_UP2SP=0 python bench.py
+# 3. facades_int8 real-photo quality with bf16 moments: 10 epochs bs=1,
+#    decayed tail start — compare trajectory against the r4/r3 runs
+run python -m p2p_tpu.cli.train --preset facades_int8 --dataset real256 \
+  --name mom16_q --moment_dtype bfloat16 --niter 5 --niter_decay 5 \
+  --nepoch 10 --epochsave 10
+echo ALL_DONE >> "$log"

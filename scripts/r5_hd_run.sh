@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 HD converged run (VERDICT r4 #4): one 1024x512 two-phase run
+# WITH a decay tail (the facades 40-epoch protocol scaled down), target =
+# beat round 3's 20-epoch peak (12.89 PSNR / 0.736 SSIM) on the cheaper
+# epochs. G1 reuses the round-4 phase-1 checkpoint (unchanged recipe);
+# the full phase runs in TWO segments with a reference-style resume in
+# between so the restore round-trip is exercised mid-run on the real
+# workload.
+set -x
+cd /root/repo
+log=/root/repo/profiles/r5_hd_run.log
+: > "$log"
+{
+  # segment 1: epochs 1-9 of an 18-epoch decayed schedule
+  python -m p2p_tpu.cli.train --preset pix2pixhd --dataset realhd \
+    --name hd_r5 --phase full --init_g1_from checkpoint/realhd/hd_r4_g1 \
+    --mesh 1,1,1 --lamb 100 --niter 10 --niter_decay 8 --nepoch 9 --epochsave 3
+  # segment 2: resume into the decay window (reference-style
+  # --epoch_count labeling; maybe_resume renormalizes the offset)
+  python -m p2p_tpu.cli.train --preset pix2pixhd --dataset realhd \
+    --name hd_r5 --phase full --init_g1_from checkpoint/realhd/hd_r4_g1 \
+    --mesh 1,1,1 --lamb 100 --niter 10 --niter_decay 8 --epoch_count 10 --nepoch 18 \
+    --epochsave 3
+  echo HD_RUN_DONE
+} >> "$log" 2>&1

@@ -649,6 +649,52 @@ def test_convlayer_thin_input_patches_equals_plain():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_nearest_up2_conv_matches_upsample_conv(monkeypatch):
+    """The subpixel decomposition of UpsampleConvLayer (×2 nearest →
+    reflect-pad → 3×3 conv ≡ one low-res 3×3 conv ci→4co + depth-to-space,
+    edge-padded) is exact: fwd + dx + dw match the plain path with the
+    SAME params, boundary rows included."""
+    import jax
+
+    from p2p_tpu.ops.conv import UpsampleConvLayer
+
+    # post-upsample extent 600·512 = 307k > the dispatch gate
+    x = jnp.asarray(rng(1, 300, 256, 8), jnp.float32)
+    layer = UpsampleConvLayer(6, kernel_size=3, upsample=2)
+
+    monkeypatch.setenv("P2P_UP2SP", "0")
+    params = layer.init(jax.random.key(0), x)
+    ref, ref_vjp = jax.vjp(lambda p, xx: layer.apply(p, xx), params, x)
+
+    monkeypatch.setenv("P2P_UP2SP", "1")
+    got, got_vjp = jax.vjp(lambda p, xx: layer.apply(p, xx), params, x)
+    # routing really changed: the subpixel path pads the LOW-RES input
+    # (300→302 rows) and never materializes a padded upsampled tensor
+    # (600→602 rows, the plain path's reflect pad)
+    jaxpr = str(jax.make_jaxpr(lambda p, xx: layer.apply(p, xx))(params, x))
+    assert "302" in jaxpr and "602" not in jaxpr
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    ct = jnp.asarray(rng(*ref.shape, seed=1), jnp.float32)
+    (dp_ref, dx_ref) = ref_vjp(ct)
+    (dp_got, dx_got) = got_vjp(ct)
+    np.testing.assert_allclose(np.asarray(dx_got), np.asarray(dx_ref),
+                               rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(dp_got),
+                    jax.tree_util.tree_leaves(dp_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+    # small extents stay on the plain path (gate): the padded UPSAMPLED
+    # tensor (64+2 = 66 rows) is materialized there
+    small = jnp.zeros((1, 32, 32, 8), jnp.float32)
+    jaxpr_small = str(jax.make_jaxpr(
+        lambda p, xx: layer.apply(p, xx))(
+            layer.init(jax.random.key(0), small), small))
+    assert "66" in jaxpr_small
+
+
 def test_thin_conv_dispatch_routing():
     """The spatial gate routes as measured: >=300k-pixel thin shapes go to
     the patches/kn2row forms (no conv_general_dilated in the jaxpr); small

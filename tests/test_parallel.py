@@ -268,3 +268,123 @@ def test_tp_train_step_matches_single_device(devices8):
     b = np.asarray(
         tp_state.params_g["ResnetBlock_0"]["ConvLayer_0"]["Conv_0"]["kernel"])
     np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+def _run_tp_equivalence(cfg, mesh, batch, min_ch, sharded_probes):
+    """Shared harness: TP-annotated step == single-device oracle, and the
+    named probe kernels really are model-axis-sharded."""
+    from p2p_tpu.parallel.dp import make_parallel_train_step, shard_batch
+    from p2p_tpu.parallel.tp import place_state_tp, tp_sharding_tree
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    ref_step = build_train_step(cfg)
+    ref_state, ref_metrics = ref_step(
+        jax.tree_util.tree_map(jnp.copy, state), dict(batch))
+
+    ssh = tp_sharding_tree(state, mesh, min_ch=min_ch)
+    tp_step = make_parallel_train_step(cfg, mesh, state_sharding=ssh)
+    tp_state = place_state_tp(state, mesh, min_ch=min_ch)
+    for tree_name, path in sharded_probes:
+        leaf = getattr(tp_state, tree_name)
+        for k in path:
+            leaf = leaf[k]
+        assert "model" in str(leaf.sharding.spec), (path, leaf.sharding)
+    tp_state, tp_metrics = tp_step(tp_state, shard_batch(batch, mesh))
+
+    for k in ref_metrics:
+        np.testing.assert_allclose(
+            float(ref_metrics[k]), float(tp_metrics[k]),
+            rtol=3e-4, atol=3e-4, err_msg=k)
+    for tree_name in ("params_g", "params_d"):
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(getattr(ref_state, tree_name)),
+            jax.tree_util.tree_leaves(getattr(tp_state, tree_name)),
+        ):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_tp_facades_unet_and_d_chain_match_single_device(devices8):
+    """VERDICT r4 #7: the widened TP coverage — U-Net encoder/bottleneck
+    pairs (down3→down4, down5→up5) AND the PatchGAN scale's shape-keyed
+    channel chain — matches the unsharded facades step, with the probe
+    kernels actually model-sharded."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.core.mesh import MeshSpec, make_mesh
+
+    cfg = get_preset("facades")
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, ngf=8, ndf=8),
+        loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=64),
+        parallel=dataclasses.replace(
+            cfg.parallel, mesh=MeshSpec(data=2, spatial=1, time=1, model=2)),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+    )
+    mesh = make_mesh(MeshSpec(data=2, spatial=1, time=1, model=2),
+                     devices=devices8[:4])
+    rng = np.random.default_rng(3)
+    batch = {
+        k: jnp.asarray(rng.uniform(-1, 1, (2, 64, 64, 3)), jnp.float32)
+        for k in ("input", "target")
+    }
+    # ngf=8 U-Net: down3..5/up5 are 64-channel; ndf=8 D chain doubles
+    # 8→16→32→64 — log2 parity out-shards 16→32 and in-shards 32→64 at
+    # min_ch=16
+    _run_tp_equivalence(
+        cfg, mesh, batch, min_ch=16,
+        sharded_probes=[
+            ("params_g", ("down3", "kernel")),       # C_out shard
+            ("params_g", ("down4", "kernel")),       # C_in shard
+            ("params_g", ("up5", "kernel")),         # bottleneck C_in
+            ("params_d", ("scale0", "_PlainConv_2", "Conv_0", "kernel")),
+            ("params_d", ("scale0", "_PlainConv_3", "Conv_0", "kernel")),
+        ],
+    )
+
+
+@pytest.mark.slow
+def test_tp_pix2pixhd_global_and_spectral_d_match_single_device(devices8):
+    """VERDICT r4 #7: TP on pix2pixHD's ``global`` encoder/decoder
+    transitions and the SpectralConv discriminator chains matches the
+    unsharded step (spectral u/v power iteration included)."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.core.mesh import MeshSpec, make_mesh
+
+    cfg = get_preset("pix2pixhd")
+    cfg = cfg.replace(
+        # norm='instance' (XLA): the Pallas InstanceNorm's manual region
+        # covers the spatial axis, not channel shards (tp.py docstring)
+        model=dataclasses.replace(cfg.model, ngf=8, ndf=8, n_blocks=1,
+                                  num_D=2, n_layers_D=2, norm="instance"),
+        loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=32,
+                                 image_width=32),
+        parallel=dataclasses.replace(
+            cfg.parallel, mesh=MeshSpec(data=2, spatial=1, time=1, model=2)),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+    )
+    mesh = make_mesh(MeshSpec(data=2, spatial=1, time=1, model=2),
+                     devices=devices8[:4])
+    rng = np.random.default_rng(4)
+    batch = {
+        k: jnp.asarray(rng.uniform(-1, 1, (2, 32, 32, 3)), jnp.float32)
+        for k in ("input", "target")
+    }
+    # ndf=8 spectral chain 8→16→32→64: parity shards SpectralConv_1
+    # (16→32, C_out) and SpectralConv_2 (32→64, C_in)
+    _run_tp_equivalence(
+        cfg, mesh, batch, min_ch=16,
+        sharded_probes=[
+            ("params_g", ("global", "ConvLayer_3", "Conv_0", "kernel")),
+            ("params_g", ("global", "ConvLayer_4", "Conv_0", "kernel")),
+            ("params_d", ("scale0", "SpectralConv_1", "kernel")),
+        ],
+    )

@@ -188,6 +188,33 @@ def test_train_step_uint8_batch_matches_f32():
                                   np.asarray(mf["psnr"]))
 
 
+def test_train_step_split_d_pairs_matches_concat(batch):
+    """ModelConfig.split_d_pairs (D fed the unconcatenated (a,b) pair,
+    the HD-extent form) matches the concat step to fp tolerance: same
+    losses, same updated G and D params."""
+    import dataclasses
+
+    cfg_c = tiny_config()
+    cfg_s = cfg_c.replace(model=dataclasses.replace(
+        cfg_c.model, split_d_pairs=True))
+    out = {}
+    for tag, cfg in (("concat", cfg_c), ("split", cfg_s)):
+        state = create_train_state(cfg, jax.random.key(0), batch, 1)
+        s1, m = build_train_step(cfg, None, 1, None)(state, dict(batch))
+        out[tag] = (s1, m)
+    for k in out["concat"][1]:
+        np.testing.assert_allclose(
+            float(out["split"][1][k]), float(out["concat"][1][k]),
+            rtol=2e-4, atol=2e-4, err_msg=k)
+    for tree in ("params_g", "params_d"):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(out["split"][0], tree)),
+            jax.tree_util.tree_leaves(getattr(out["concat"][0], tree)),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+
+
 def test_scale_by_adam_lp_matches_f32_adam():
     """scale_by_adam_lp (bf16-stored moments, OptimConfig.moment_dtype):
     with float32 storage it reproduces optax.adam's trajectory exactly
