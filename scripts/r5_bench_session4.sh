@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-5 session 4: confirm the shipped defaults — driver-default
+# headline (uint8 + bf16-moment preset), pix2pixhd preset default
+# (subpixel + split-D), vid2vid regression sanity.
+cd /root/repo
+log=/root/repo/profiles/r5_session4.log
+: > "$log"
+run() {
+  echo "=== $* ===" >> "$log"
+  ( "$@" ) >> "$log" 2>&1
+  echo "" >> "$log"
+}
+run python bench.py
+run env BENCH_PRESET=pix2pixhd python bench.py
+run env BENCH_PRESET=vid2vid_temporal python bench.py
+run env BENCH_PRESET=cityscapes_spatial python bench.py
+run env BENCH_PRESET=edges2shoes_dp python bench.py
+run env BENCH_BS=1 BENCH_SCAN=64 BENCH_STEPS=512 python bench.py
+echo ALL_DONE >> "$log"
