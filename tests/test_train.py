@@ -352,6 +352,44 @@ def test_checkpoint_roundtrip(tmp_path, batch):
     mgr.close()
 
 
+def test_checkpoint_f32_moments_restore_into_bf16_template(tmp_path, batch):
+    """Backward compat for the round-5 facades_int8 preset flip: an OLD
+    checkpoint (f32 Adam moments) restores into the NEW template (bf16
+    moments, OptimConfig.moment_dtype) — Orbax casts to the template
+    dtype, preserving the moment VALUES to bf16 rounding rather than
+    leaving template zeros or raising."""
+    import dataclasses
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    cfg16 = tiny_config()
+    cfg16 = cfg16.replace(optim=dataclasses.replace(
+        cfg16.optim, moment_dtype="bfloat16"))
+    cfg32 = tiny_config()
+
+    old = create_train_state(cfg32, jax.random.key(0), batch, 1)
+    old, _ = build_train_step(cfg32, None, 1, None)(old, dict(batch))
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, old, wait=True)
+    template = create_train_state(cfg16, jax.random.key(1), batch, 1)
+    restored = mgr.restore(template)
+    mgr.close()
+
+    checked = 0
+    for a, b in zip(jax.tree_util.tree_leaves(old.opt_g),
+                    jax.tree_util.tree_leaves(restored.opt_g)):
+        a32 = np.asarray(a, np.float32)
+        if a32.size <= 10 or np.abs(a32).max() == 0:
+            continue
+        assert np.asarray(b).dtype == jnp.bfloat16
+        rel = (np.abs(a32 - np.asarray(b, np.float32)).max()
+               / np.abs(a32).max())
+        assert rel < 1e-2, rel   # bf16 rounding, not zeros
+        checked += 1
+    assert checked > 0
+
+
 @pytest.mark.slow
 def test_multi_step_scan_matches_sequential():
     """build_multi_train_step(K) == K sequential build_train_step calls."""
