@@ -155,12 +155,17 @@ def main() -> None:
 
         state = create_video_train_state(cfg, jax.random.key(0), single,
                                          train_dtype=dtype)
-        step = build_multi_video_train_step(cfg, vgg_params,
-                                            train_dtype=dtype)
+        step = build_multi_video_train_step(
+            cfg, vgg_params, train_dtype=dtype,
+            unroll=int(os.environ.get("BENCH_UNROLL", "1")))
     else:
         state = create_train_state(cfg, jax.random.key(0), single,
                                    train_dtype=dtype)
-        step = build_multi_train_step(cfg, vgg_params, train_dtype=dtype)
+        # BENCH_UNROLL: lax.scan unroll factor (default 1); >1 trades
+        # compile time/code size for cross-step scheduling freedom
+        step = build_multi_train_step(
+            cfg, vgg_params, train_dtype=dtype,
+            unroll=int(os.environ.get("BENCH_UNROLL", "1")))
 
     # tunnel round-trip cost of one trivial fetch
     trivial = jax.jit(lambda v: v + 1)

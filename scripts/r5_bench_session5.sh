@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-5 session 5: unnamed headline knobs — scan length, scan unroll —
+# plus the edges2shoes int8 row refresh on the uint8 default.
+cd /root/repo
+log=/root/repo/profiles/r5_session5.log
+: > "$log"
+run() {
+  echo "=== $* ===" >> "$log"
+  ( "$@" ) >> "$log" 2>&1
+  echo "" >> "$log"
+}
+run env BENCH_SCAN=16 python bench.py
+run env BENCH_UNROLL=2 python bench.py
+run env BENCH_PRESET=edges2shoes_dp BENCH_INT8=1 BENCH_DELAYED=1 python bench.py
+echo ALL_DONE >> "$log"
