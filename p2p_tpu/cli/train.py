@@ -29,8 +29,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workdir", type=str, default=".",
                    help="checkpoints/results/metrics land here")
     p.add_argument("--mesh", type=str, default=None,
-                   help="mesh axes 'data,spatial,time' e.g. '4,2,1' "
-                        "(data may be -1 = all remaining devices)")
+                   help="mesh axes 'data,spatial,time[,model[,pipe]]' e.g. "
+                        "'4,2,1' (data may be -1 = all remaining devices)")
     p.add_argument("--image_width", type=int, default=None,
                    help="image width when not square (e.g. pix2pixhd "
                         "1024x512 trains height=512 width=1024)")
@@ -193,21 +193,23 @@ def config_from_flags(args: argparse.Namespace) -> Config:
 
         try:
             vals = [int(v) for v in args.mesh.split(",")]
-            if len(vals) == 3:
+            if len(vals) < 3:   # only model/pipe are optional
+                raise ValueError("too few axes")
+            while len(vals) < 5:
                 vals.append(1)
-            d, s, t, m = vals
+            d, s, t, m, pp = vals
         except ValueError:
             raise SystemExit(
-                f"--mesh must be 'data,spatial,time[,model]' comma-separated "
-                f"ints (got {args.mesh!r})"
+                f"--mesh must be 'data,spatial,time[,model[,pipe]]' "
+                f"comma-separated ints (got {args.mesh!r})"
             )
-        if s < 1 or t < 1 or m < 1 or (d < 1 and d != -1):
+        if s < 1 or t < 1 or m < 1 or pp < 1 or (d < 1 and d != -1):
             raise SystemExit(
                 "--mesh axes must be >=1 (data may be -1 = all remaining "
                 f"devices); got {args.mesh!r}"
             )
         par = dataclasses.replace(
-            par, mesh=MeshSpec(data=d, spatial=s, time=t, model=m))
+            par, mesh=MeshSpec(data=d, spatial=s, time=t, model=m, pipe=pp))
     name = args.name or cfg.name
     cfg = dataclasses.replace(
         cfg, name=name, model=model, loss=loss, optim=optim, data=data,

@@ -33,7 +33,8 @@ DATA_AXIS = "data"
 SPATIAL_AXIS = "spatial"
 TIME_AXIS = "time"
 MODEL_AXIS = "model"   # tensor parallelism: conv channel dims (parallel/tp.py)
-ALL_AXES = (DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS)
+PIPE_AXIS = "pipe"     # pipeline parallelism: trunk stages (parallel/pp.py)
+ALL_AXES = (DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS, PIPE_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,23 +45,25 @@ class MeshSpec:
     spatial: int = 1
     time: int = 1
     model: int = 1   # tensor-parallel axis (channel dims; parallel/tp.py)
+    pipe: int = 1    # pipeline-parallel axis (trunk stages; parallel/pp.py)
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        d, s, t, m = self.data, self.spatial, self.time, self.model
-        fixed = s * t * m
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        d, s, t, m, p = (self.data, self.spatial, self.time, self.model,
+                         self.pipe)
+        fixed = s * t * m * p
         if d == -1:
             if n_devices % fixed:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"spatial*time*model={fixed}"
+                    f"spatial*time*model*pipe={fixed}"
                 )
             d = n_devices // fixed
-        if d * s * t * m > n_devices:
+        if d * s * t * m * p > n_devices:
             raise ValueError(
-                f"mesh {d}x{s}x{t}x{m} needs more than the {n_devices} "
+                f"mesh {d}x{s}x{t}x{m}x{p} needs more than the {n_devices} "
                 "devices available"
             )
-        return d, s, t, m
+        return d, s, t, m, p
 
 
 def make_mesh(
@@ -69,16 +72,18 @@ def make_mesh(
 ) -> Mesh:
     """Build the global mesh.
 
-    Axis order is (data, spatial, time) with data outermost: JAX lays devices
-    out so the *innermost* axes are nearest-neighbor on the ICI torus, which
-    is where the bandwidth-hungry halo exchanges (spatial) and ring shifts
-    (time) live; data-parallel all-reduces tolerate the longer hops.
+    Axis order is (data, spatial, time, model, pipe) with data outermost: JAX
+    lays devices out so the *innermost* axes are nearest-neighbor on the ICI
+    torus, which is where the bandwidth-hungry halo exchanges (spatial), ring
+    shifts (time), and pipeline stage hand-offs (pipe: neighbor ppermute every
+    tick) live; data-parallel all-reduces tolerate the longer hops.
     """
     devices = list(devices if devices is not None else jax.devices())
-    d, s, t, m = spec.resolve(len(devices))
-    dev_array = np.asarray(devices[: d * s * t * m]).reshape(d, s, t, m)
+    d, s, t, m, p = spec.resolve(len(devices))
+    dev_array = np.asarray(devices[: d * s * t * m * p]).reshape(d, s, t, m, p)
     return Mesh(
-        dev_array, axis_names=(DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS)
+        dev_array,
+        axis_names=(DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS, PIPE_AXIS),
     )
 
 

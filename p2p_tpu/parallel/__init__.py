@@ -8,13 +8,14 @@
                exchange for the stride-1 conv trunk.
 - ``temporal`` sequence parallelism over video frames for the vid2vid
                temporal discriminator.
+- ``pp``       pipeline parallelism: GPipe fill/drain over the generator's
+               residual trunk on the ``pipe`` mesh axis (stacked stage
+               params, neighbor ppermute hand-offs, autodiff backward).
 - ``halo``     the shared nearest-neighbor ppermute halo-exchange primitive.
 
 Not applicable to this model family (documented, per SURVEY §2.4): expert
 parallelism (no MoE), ring/Ulysses attention (no attention ops — the
-spatial/temporal halo exchange is the conv equivalent). Pipeline parallelism
-is out of scope v1; the mesh reserves no axis for it but ``MeshSpec`` is the
-single place to add one.
+spatial/temporal halo exchange is the conv equivalent).
 """
 
 from p2p_tpu.parallel.dp import (
@@ -24,6 +25,14 @@ from p2p_tpu.parallel.dp import (
     shard_batch,
 )
 from p2p_tpu.parallel.halo import halo_exchange, ring_shift
+from p2p_tpu.parallel.pp import (
+    gpipe_trunk,
+    make_expand_block_apply,
+    make_resnet_block_apply,
+    place_trunk_pp,
+    pp_expand_forward,
+    stack_trunk,
+)
 from p2p_tpu.parallel.tp import place_state_tp, tp_sharding_tree
 from p2p_tpu.parallel.spatial import (
     check_spatial_divisible,
@@ -45,6 +54,12 @@ __all__ = [
     "replicate_state",
     "shard_batch",
     "halo_exchange",
+    "gpipe_trunk",
+    "make_expand_block_apply",
+    "make_resnet_block_apply",
+    "place_trunk_pp",
+    "pp_expand_forward",
+    "stack_trunk",
     "place_state_tp",
     "tp_sharding_tree",
     "ring_shift",

@@ -1,0 +1,291 @@
+"""Pipeline parallelism (GPipe) over the ``pipe`` mesh axis — SURVEY §2.4 PP row.
+
+The reference is single-device (no pipeline anywhere in /root/reference);
+SURVEY §2.4 scoped PP "out-of-scope v1, design mesh axes so it can be
+added". This module adds it, TPU-native:
+
+- **Stage unit** — the generator's residual trunk: the only depth-regular,
+  FLOP-dominant segment in the zoo (9 identical 128-ch blocks in the
+  flagship ExpandNetwork, networks.py:472-480; ``n_blocks`` up to 9 in the
+  ResNet family). Each of the S pipeline stages owns ``n_blocks/S``
+  consecutive blocks; their parameters are *stacked* along a leading stage
+  axis and sharded over ``pipe``, so stage weights live only on their
+  stage's devices (the point of PP: fit a deeper trunk than one chip's HBM).
+- **Schedule** — GPipe fill/drain over M microbatches inside ONE jitted
+  ``shard_map``: every tick each stage applies its block stack
+  (``lax.scan`` over the stacked block params) and hands its activation to
+  the next stage with a neighbor ``ppermute`` (``pipe`` is the innermost
+  mesh axis — the shift is one ICI hop). T = M + S − 1 ticks; bubble
+  fraction (S−1)/T exactly as GPipe.
+- **Backward** — ``jax.grad`` of the same program: the transpose of
+  ``ppermute`` is the reverse shift, so autodiff derives the reverse-order
+  pipeline schedule with no hand-written VJP.
+- **Norm semantics** — microbatching changes *train-mode BatchNorm*
+  statistics (per-microbatch instead of per-batch — the GPipe paper's BN
+  caveat), so the pipelined trunk applies blocks with frozen (eval)
+  BatchNorm stats. InstanceNorm models are unaffected (per-sample stats):
+  for the instance-norm family (cityscapes / pix2pixHD — where model scale
+  actually motivates PP) the pipelined forward AND gradients are exact vs
+  the train-mode unpipelined model; for the BatchNorm flagship they are
+  exact vs eval mode. Both pinned in tests/test_pp.py.
+
+Composability: the microbatch batch axis stays sharded over ``data``
+(in-spec ``P(None, 'data', ...)``), so PP composes with DP on one mesh —
+exercised by the dryrun phase 5 (data=2 × pipe=4) and tests.
+
+Single-chip note: this environment exposes ONE real TPU chip, so PP here is
+validated for numerics on the fake CPU mesh and compile-checked via the
+driver dryrun, like TP (parallel/tp.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_tpu.core.mesh import DATA_AXIS, PIPE_AXIS
+
+BlockApply = Callable[[Dict[str, Any], jax.Array], jax.Array]
+
+
+def stack_trunk(variables: Dict[str, Any], n_stages: int,
+                prefix: str = "ResidualBlock_") -> Dict[str, Any]:
+    """Stack the trunk's per-block variable subtrees into stage-major arrays.
+
+    Returns a tree shaped like one block's variables but with every leaf
+    prefixed by ``[S, B]`` axes (S stages × B = n_blocks/S blocks per
+    stage); block ``s*B + j`` sits at ``[s, j]``, so scanning j within a
+    pipelined stage s applies blocks in the original serial order.
+    """
+    names = [n for n in variables["params"] if n.startswith(prefix)]
+    names.sort(key=lambda n: int(n[len(prefix):]))
+    n_blocks = len(names)
+    if n_blocks == 0:
+        raise ValueError(f"no {prefix}* blocks in variables")
+    if n_blocks % n_stages:
+        raise ValueError(
+            f"{n_blocks} trunk blocks not divisible by {n_stages} stages")
+    per = n_blocks // n_stages
+
+    def gather(collection):
+        blocks = [collection[n] for n in names]
+        flat = jax.tree.map(lambda *leaves: jnp.stack(leaves), *blocks)
+        return jax.tree.map(
+            lambda a: a.reshape((n_stages, per) + a.shape[1:]), flat)
+
+    stacked = {"params": gather(variables["params"])}
+    stats = variables.get("batch_stats", {})
+    if names[0] in stats:
+        stacked["batch_stats"] = gather(stats)
+    return stacked
+
+
+def place_trunk_pp(stacked: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """Shard the stacked trunk stage-axis over ``pipe`` (each stage's block
+    weights live only on that stage's devices)."""
+    sh = NamedSharding(mesh, P(PIPE_AXIS))
+    return jax.tree.map(lambda a: jax.device_put(a, sh), stacked)
+
+
+def gpipe_trunk(block_apply: BlockApply, stacked: Dict[str, Any],
+                y_mb: jax.Array, mesh: Mesh) -> jax.Array:
+    """Run the stacked trunk over ``y_mb`` [M, mb, H, W, C] with the GPipe
+    fill/drain schedule on the mesh's ``pipe`` axis.
+
+    ``block_apply(block_vars, y) -> y`` applies ONE residual block given its
+    (unstacked) variable subtree. Output has the same shape/sharding as
+    ``y_mb`` (mb stays on ``data``); result is replicated over ``pipe``.
+    """
+    n_stages = mesh.shape[PIPE_AXIS]
+    n_micro = int(y_mb.shape[0])
+    ticks = n_micro + n_stages - 1
+    act_spec = P(None, DATA_AXIS, *([None] * (y_mb.ndim - 2)))
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def shard_fn(st, xmb):
+        local = jax.tree.map(lambda a: a[0], st)   # this stage's [B, ...]
+        idx = jax.lax.axis_index(PIPE_AXIS)
+
+        def stage(y):
+            def body(c, bv):
+                return block_apply(bv, c), None
+            y, _ = jax.lax.scan(body, y, local)
+            return y
+
+        def tick(carry, t):
+            act, out = carry
+            # stage 0 injects microbatch t (clamped re-feeds during drain
+            # are bubble ticks whose output is never written)
+            feed = jax.lax.dynamic_index_in_dim(
+                xmb, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
+            y_out = stage(jnp.where(idx == 0, feed, act))
+            # last stage retires microbatch t-(S-1) into its output slot
+            o_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            prev = jax.lax.dynamic_index_in_dim(out, o_idx, 0, keepdims=False)
+            write = jnp.logical_and(t >= n_stages - 1, idx == n_stages - 1)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(write, y_out, prev), o_idx, 0)
+            return (jax.lax.ppermute(y_out, PIPE_AXIS, perm), out), None
+
+        # carries are stage-varying (idx enters tick) — pcast the replicated
+        # zeros to the varying type shard_map's vma tracking expects
+        zero = jax.lax.pcast(
+            jnp.zeros(xmb.shape[1:], xmb.dtype), (DATA_AXIS, PIPE_AXIS),
+            to="varying")
+        out0 = jax.lax.pcast(jnp.zeros_like(xmb), (PIPE_AXIS,), to="varying")
+        (act, out), _ = jax.lax.scan(tick, (zero, out0), jnp.arange(ticks))
+        # non-last stages accumulated zeros; the masked psum replicates the
+        # last stage's outputs to every pipe shard
+        return jax.lax.psum(
+            jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out)),
+            PIPE_AXIS)
+
+    return shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(PIPE_AXIS), act_spec), out_specs=act_spec,
+    )(stacked, y_mb)
+
+
+# ---------------------------------------------------------------------------
+# Flagship wiring: pipelined ExpandNetwork forward
+# ---------------------------------------------------------------------------
+
+
+def make_expand_block_apply(model_cfg, dtype=None) -> BlockApply:
+    """Block applier for ExpandNetwork's ``ResidualBlock_i`` trunk
+    (frozen-stat norms — see module docstring)."""
+    from p2p_tpu.models.expand import ResidualBlock
+
+    if model_cfg.int8 and model_cfg.int8_generator:
+        # the int8-delayed trunk carries a 'quant' scale collection that
+        # stack_trunk does not stack (and that wants mutation per step)
+        raise NotImplementedError(
+            "pp v1 does not pipeline the int8 trunk; run int8 configs "
+            "unpipelined or stack the 'quant' collection first")
+    block = ResidualBlock(
+        model_cfg.ngf * 4, norm=model_cfg.norm,
+        legacy_layout=model_cfg.legacy_layout, dtype=dtype)
+
+    def apply_one(bvars, y):
+        return block.apply(bvars, y, False)
+
+    return apply_one
+
+
+def make_resnet_block_apply(features: int, norm: str = "instance",
+                            legacy_layout: bool = False,
+                            dtype=None) -> BlockApply:
+    """Block applier for the ResNet family's ``ResnetBlock_i`` trunk
+    (models/resnet_gen.py — cityscapes and pix2pixHD's ``global``/G1,
+    whose 1024-channel trunk is where PP actually pays). Use with
+    ``stack_trunk(variables, n_stages, prefix="ResnetBlock_")`` and
+    ``gpipe_trunk``. Instance norm is per-sample, so the pipelined trunk
+    is exact vs train mode (module docstring)."""
+    from p2p_tpu.models.resnet_gen import ResnetBlock
+
+    block = ResnetBlock(features, norm=norm, legacy_layout=legacy_layout,
+                        dtype=dtype)
+
+    def apply_one(bvars, y):
+        return block.apply(bvars, y, False)
+
+    return apply_one
+
+
+def pp_expand_forward(model_cfg, variables: Dict[str, Any], x_mb: jax.Array,
+                      mesh: Mesh,
+                      stacked: Optional[Dict[str, Any]] = None,
+                      dtype=None) -> jax.Array:
+    """Full pipelined flagship (ExpandNetwork) forward.
+
+    ``x_mb``: [M, mb, H, W, 3] microbatched input (mb sharded over ``data``).
+    Encoder/decoder run replicated over ``pipe`` on the flat batch (they are
+    <15% of the FLOPs — networks.py:460-520; pipelining them buys nothing at
+    this depth); the residual trunk runs the GPipe schedule. Mirrors
+    ExpandNetwork.__call__ (models/expand.py) name-for-name — drift between
+    the two is pinned bitwise by tests/test_pp.py.
+    """
+    if model_cfg.generator != "expand":
+        raise NotImplementedError(
+            "pp v1 pipelines the ExpandNetwork trunk; for the ResNet family "
+            "use gpipe_trunk() directly with a ResnetBlock applier")
+
+    from p2p_tpu.models.expand import ResidualBlock  # noqa: F401  (doc link)
+    from p2p_tpu.ops.activations import PReLU, leaky_relu_y, tanh_y
+    from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, upsample_nearest
+    from p2p_tpu.ops.norm import make_norm
+    from p2p_tpu.ops.pixel_shuffle import pixel_unshuffle
+
+    p = variables["params"]
+    bs = variables.get("batch_stats", {})
+    cfg = model_cfg
+    ub = cfg.legacy_layout or cfg.norm == "none"
+    mk = make_norm(cfg.norm, train=False, dtype=dtype)
+
+    def norm_at(i, y):
+        if cfg.norm == "none":
+            return y
+        name = f"{type(mk()).__name__}_{i}"
+        vs = {}
+        if name in p:
+            vs["params"] = p[name]
+        if name in bs:
+            vs["batch_stats"] = bs[name]
+        return mk().apply(vs, y)
+
+    def act(y):
+        return PReLU().apply({"params": p["PReLU_0"]}, y)
+
+    if stacked is None:
+        stacked = stack_trunk(variables, mesh.shape[PIPE_AXIS])
+
+    n_micro, mb = x_mb.shape[0], x_mb.shape[1]
+
+    def flat(t):
+        # [M, mb, ...] -> [mb*M, ...] *mb-major*: the data-sharded mb axis
+        # stays outermost so GSPMD keeps the encoder/decoder data-parallel
+        # (an M-major flatten interleaves the shards and forces XLA to
+        # all-gather the full batch onto every device)
+        return jnp.swapaxes(t, 0, 1).reshape((mb * n_micro,) + t.shape[2:])
+
+    def unflat(t):
+        return jnp.swapaxes(
+            t.reshape((mb, n_micro) + t.shape[1:]), 0, 1)
+
+    x = flat(x_mb)
+
+    # --- encoder (replicated over pipe; flat batch) ---
+    y = pixel_unshuffle(x, 2)
+    y = upsample_nearest(y, 2)
+    y = act(norm_at(0, ConvLayer(cfg.ngf, kernel_size=9, use_bias=ub, dtype=dtype)
+                    .apply({"params": p["ConvLayer_0"]}, y)))
+    y = act(norm_at(1, ConvLayer(cfg.ngf * 2, kernel_size=3, stride=2,
+                                 use_bias=ub, dtype=dtype)
+                    .apply({"params": p["ConvLayer_1"]}, y)))
+    y = act(norm_at(2, ConvLayer(cfg.ngf * 4, kernel_size=3, stride=2,
+                                 use_bias=ub, dtype=dtype)
+                    .apply({"params": p["ConvLayer_2"]}, y)))
+
+    # --- pipelined residual trunk ---
+    residual = y
+    y_mb = gpipe_trunk(make_expand_block_apply(cfg, dtype), stacked,
+                       unflat(y), mesh)
+    y = leaky_relu_y(flat(y_mb) + residual, 0.2)
+
+    # --- decoder ---
+    y = act(norm_at(3, UpsampleConvLayer(cfg.ngf * 2, kernel_size=3,
+                                         upsample=2, use_bias=ub, dtype=dtype)
+                    .apply({"params": p["UpsampleConvLayer_0"]}, y)))
+    y = act(norm_at(4, UpsampleConvLayer(cfg.ngf, kernel_size=3, upsample=2,
+                                         use_bias=ub, dtype=dtype)
+                    .apply({"params": p["UpsampleConvLayer_1"]}, y)))
+    y = UpsampleConvLayer(cfg.output_nc, kernel_size=9, use_bias=ub,
+                                      dtype=dtype).apply(
+        {"params": p["UpsampleConvLayer_2"]}, y)
+    y = norm_at(5, y)
+    y = tanh_y(y)
+    return unflat(y)

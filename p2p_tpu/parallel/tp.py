@@ -48,9 +48,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2p_tpu.core.mesh import MODEL_AXIS
 
-# ResnetBlock conv-pair leaves, wherever they sit in a pytree (params_g or
-# the param-structured optimizer moments mu/nu).
-_PAT = re.compile(r"ResnetBlock_\d+'?\]?\['ConvLayer_(\d)'\]\['Conv_0'\]")
+# Residual-trunk conv-pair leaves, wherever they sit in a pytree (params_g
+# or the param-structured optimizer moments mu/nu). Covers both trunk
+# namings: ``ResnetBlock_i`` (cityscapes / pix2pixHD families,
+# models/resnet_gen.py) and ``ResidualBlock_i`` (the flagship
+# ExpandNetwork, models/expand.py — networks.py:472-480). The inner
+# structure is identical: ConvLayer_0 (C_out shard) → per-channel norm →
+# ConvLayer_1 (C_in shard, one psum to rebuild the residual).
+_PAT = re.compile(
+    r"Res(?:net|idual)Block_\d+'?\]?\['ConvLayer_(\d)'\]\['Conv_0'\]")
 
 # Round-5 extension (VERDICT r4 #7): Megatron pairs beyond the ResNet
 # trunk. Named pairs for the generators (stable flax names):

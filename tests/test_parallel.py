@@ -388,3 +388,43 @@ def test_tp_pix2pixhd_global_and_spectral_d_match_single_device(devices8):
             ("params_d", ("scale0", "SpectralConv_1", "kernel")),
         ],
     )
+
+
+@pytest.mark.slow
+def test_tp_expand_flagship_trunk_matches_single_device(devices8):
+    """Round-5 TP widening, part 2: the flagship ExpandNetwork's
+    ``ResidualBlock_i`` trunk (the reference-faithful preset's G —
+    networks.py:472-480) channel-shards under the same Megatron pair rule
+    as the ResNet family, and the TP step matches the unsharded oracle."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.core.mesh import MeshSpec, make_mesh
+
+    cfg = get_preset("reference")
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, ngf=8, ndf=8, n_blocks=2,
+                                  num_D=2, n_layers_D=2),
+        loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=32),
+        parallel=dataclasses.replace(
+            cfg.parallel, mesh=MeshSpec(data=2, spatial=1, time=1, model=2)),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+    )
+    mesh = make_mesh(MeshSpec(data=2, spatial=1, time=1, model=2),
+                     devices=devices8[:4])
+    rng = np.random.default_rng(5)
+    batch = {
+        k: jnp.asarray(rng.uniform(-1, 1, (2, 32, 32, 3)), jnp.float32)
+        for k in ("input", "target")
+    }
+    # ngf=8 trunk: 32-channel ResidualBlock conv pairs shard at min_ch=16
+    _run_tp_equivalence(
+        cfg, mesh, batch, min_ch=16,
+        sharded_probes=[
+            ("params_g", ("ResidualBlock_0", "ConvLayer_0", "Conv_0",
+                          "kernel")),
+            ("params_g", ("ResidualBlock_1", "ConvLayer_1", "Conv_0",
+                          "kernel")),
+        ],
+    )
