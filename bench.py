@@ -90,6 +90,11 @@ def main() -> None:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, thin_head=True))
         preset = preset + "_th"
+    if os.environ.get("BENCH_STEM", "") == "1":
+        # U-Net k4-s2 stem as strided patches (ModelConfig.thin_stem)
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, thin_stem=True))
+        preset = preset + "_st"
     if os.environ.get("BENCH_HPAL", "") == "1":
         # thin head through the Pallas fused kernel (bypass the Mosaic
         # gate so runtime upgrades get re-probed — ops/conv.py)

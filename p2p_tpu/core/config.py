@@ -110,6 +110,11 @@ class ModelConfig:
     # kernel (ops/pallas/subpixel_head.py — x read once per sample
     # block, tap matmuls accumulated in VMEM) instead of the XLA conv.
     head_pallas: bool = False
+    # U-Net k4-s2 RGB stem (down0) as strided im2col patches + one dense
+    # matmul (ops/conv.py PatchesConv with stride) — targets the bs=1
+    # profile's 0.7 TF/s / 17 GB/s down0 wgrad. Off by default pending
+    # an on-chip win; A/B via BENCH_STEM=1.
+    thin_stem: bool = False
     # Feed D the UNCONCATENATED (a, b) conditional pair (the split-stem
     # form, models/patchgan._SplitStemConv): no materialized 6-channel
     # full-res pair tensors, conv(a, W_a) CSE-shared across the fake/real

@@ -53,6 +53,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             legacy_layout=cfg.legacy_layout,
             thin_head=cfg.thin_head,
             head_pallas=cfg.head_pallas,
+            thin_stem=cfg.thin_stem,
             dtype=dtype,
         )
     if cfg.generator == "resnet":

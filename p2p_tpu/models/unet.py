@@ -74,6 +74,12 @@ class UNetGenerator(nn.Module):
     thin_head: bool = False
     # with thin_head: Pallas fused kernel for the head's k2 conv
     head_pallas: bool = False
+    # k4-s2 RGB stem as strided patches + dense matmul (PatchesConv):
+    # the zero-padded 3-ch stem's wgrad collapses XLA to 0.7 TF/s at
+    # bs=1 (profiles/prof_r5_facades_bs1.txt); the patch form makes
+    # fwd AND dW full-rate dot_generals (dx is dead — input is the
+    # image). Param tree identical to nn.Conv (kernel HWIO + bias).
+    thin_stem: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -98,7 +104,8 @@ class UNetGenerator(nn.Module):
                 "head_pallas requires thin_head (the subpixel head form) "
                 "and the default (non-legacy) layout")
 
-        def down_conv(y, features, name, int8=False, norm_after=False):
+        def down_conv(y, features, name, int8=False, norm_after=False,
+                      stem=False):
             bias = not norm_after
             if int8:
                 from p2p_tpu.ops.int8 import QuantConv
@@ -108,6 +115,17 @@ class UNetGenerator(nn.Module):
                     use_bias=bias, dtype=self.dtype,
                     kernel_init=normal_init(), name=name,
                     delayed=self.int8_delayed,
+                )(y)
+            # stem only: PatchesConv's input cotangent is the slow
+            # k²-pad accumulation — dead for the image stem, live (and
+            # pathological) anywhere deeper
+            if self.thin_stem and stem and y.shape[-1] <= 8:
+                from p2p_tpu.ops.conv import PatchesConv
+
+                return PatchesConv(
+                    features, kernel_size=4, stride=2, zero_pad=1,
+                    use_bias=bias, dtype=self.dtype,
+                    kernel_init=normal_init(), name=name,
                 )(y)
             return save_conv_out(nn.Conv(
                 features, kernel_size=(4, 4), strides=(2, 2), padding=1,
@@ -125,7 +143,8 @@ class UNetGenerator(nn.Module):
                 y = leaky_relu_y(y, 0.2)
             y = down_conv(y, f, name=f"down{i}",
                           int8=self.int8 and i > 0,
-                          norm_after=normed and 0 < i < num_downs - 1)
+                          norm_after=normed and 0 < i < num_downs - 1,
+                          stem=i == 0)
             # no norm on the outermost and innermost encoder convs
             if 0 < i < num_downs - 1:
                 y = mk()(y)

@@ -225,30 +225,37 @@ def kn2row_thin_conv(x: jax.Array, w: jax.Array, pad: int) -> jax.Array:
     return y.astype(x.dtype)
 
 
-def im2col_patches(x: jax.Array, k: int) -> jax.Array:
-    """VALID im2col: (N, H, W, C) → (N, H−k+1, W−k+1, k²·C), feature
-    order (kh, kw, c) — i.e. an HWIO kernel flattens to the matching
-    matrix with a plain ``w.reshape(k·k·C, F)``.
+def im2col_patches(x: jax.Array, k: int, stride: int = 1) -> jax.Array:
+    """VALID im2col: (N, H, W, C) → (N, (H−k)//s+1, (W−k)//s+1, k²·C),
+    feature order (kh, kw, c) — i.e. an HWIO kernel flattens to the
+    matching matrix with a plain ``w.reshape(k·k·C, F)``.
 
-    Built from k² static slices + one channel concat (pure HBM movement
-    at full rate) — NOT ``lax.conv_general_dilated_patches``, whose
-    lowering is itself a thin-input conv and inherits the 3 TF/s
+    Built from k² static (strided) slices + one channel concat (pure HBM
+    movement at full rate) — NOT ``lax.conv_general_dilated_patches``,
+    whose lowering is itself a thin-input conv and inherits the 3 TF/s
     pathology this path exists to avoid (measured on the pix2pixHD
     enhancer stem).
     """
     n, h, w, c = x.shape
-    ho, wo = h - k + 1, w - k + 1
+    ho = (h - k) // stride + 1
+    wo = (w - k) // stride + 1
     cols = [
-        jax.lax.slice(x, (0, kh, kw, 0), (n, kh + ho, kw + wo, c))
+        jax.lax.slice(
+            x, (0, kh, kw, 0),
+            (n, kh + stride * (ho - 1) + 1, kw + stride * (wo - 1) + 1, c),
+            (1, stride, stride, 1))
         for kh in range(k) for kw in range(k)
     ]
     return jnp.concatenate(cols, axis=-1)
 
 
 class PatchesConv(nn.Module):
-    """Stride-1 conv for THIN-INPUT stems (C_in ≤ 8, e.g. the pix2pixHD
-    enhancer's RGB stem at 1024×512) as explicit im2col patches + one
-    dense matmul.
+    """Conv for THIN-INPUT stems (C_in ≤ 8, e.g. the pix2pixHD enhancer's
+    RGB stem at 1024×512; optionally strided/zero-padded for the U-Net's
+    k4-s2 stem) as explicit im2col patches + one dense matmul. The
+    ConvLayer auto-dispatch (`_thin_stem_eligible`) covers only the
+    stride-1 pre-padded form; strided use is opt-in via
+    ``ModelConfig.thin_stem``.
 
     XLA's conv kernels collapse on 3-input-channel convs at big spatial
     extents: the pix2pixHD enhancer stem profiled 0.6 TF/s forward and
@@ -265,11 +272,16 @@ class PatchesConv(nn.Module):
 
     Param tree ("kernel" HWIO + "bias") matches ``nn.Conv``; callers name
     it ``Conv_0`` so checkpoints interchange. Input arrives pre-padded
-    (VALID), as with the other ConvLayer branches.
+    (VALID), as with the other ConvLayer branches — except when
+    ``zero_pad`` is set (the U-Net's zero-padded k4-s2 stem, whose bs=1
+    wgrad profiles at 0.7 TF/s / 17 GB/s — utilization-bound, exactly
+    this dispatch's target).
     """
 
     features: int
     kernel_size: int
+    stride: int = 1
+    zero_pad: int = 0
     use_bias: bool = True
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
@@ -281,7 +293,10 @@ class PatchesConv(nn.Module):
         kernel = self.param("kernel", self.kernel_init,
                             (k, k, cin, self.features), jnp.float32)
         dt = self.dtype or jnp.float32
-        patches = im2col_patches(x.astype(dt), k)
+        if self.zero_pad:
+            p = self.zero_pad
+            x = jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
+        patches = im2col_patches(x.astype(dt), k, self.stride)
         wmat = kernel.reshape(k * k * cin, self.features)
         y = jax.lax.dot_general(
             patches, wmat.astype(dt), (((3,), (0,)), ((), ())),

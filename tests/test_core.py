@@ -10,9 +10,9 @@ from p2p_tpu.core.rng import RngStream
 
 def test_mesh_shapes(devices8):
     mesh = make_mesh(MeshSpec(data=-1, spatial=2), devices=devices8)
-    assert mesh.shape == {"data": 4, "spatial": 2, "time": 1, "model": 1}
+    assert mesh.shape == {"data": 4, "spatial": 2, "time": 1, "model": 1, "pipe": 1}
     mesh = make_mesh(MeshSpec(data=2, spatial=2, time=2), devices=devices8)
-    assert mesh.shape == {"data": 2, "spatial": 2, "time": 2, "model": 1}
+    assert mesh.shape == {"data": 2, "spatial": 2, "time": 2, "model": 1, "pipe": 1}
 
 
 def test_mesh_bad_shape(devices8):
@@ -22,7 +22,7 @@ def test_mesh_bad_shape(devices8):
         make_mesh(MeshSpec(data=-1, spatial=3), devices=devices8)  # 8 % 3
     # explicit sub-mesh is allowed: uses the first d*s*t devices
     m = make_mesh(MeshSpec(data=2, spatial=2), devices=devices8)
-    assert m.shape == {"data": 2, "spatial": 2, "time": 1, "model": 1}
+    assert m.shape == {"data": 2, "spatial": 2, "time": 1, "model": 1, "pipe": 1}
 
 
 def test_shardings_build(devices8):

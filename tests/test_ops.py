@@ -730,3 +730,47 @@ def test_thin_conv_dispatch_routing():
     # UpsampleConvLayer shares the head predicate (Expand's k9→3)
     big_up = jaxpr_of(UpsampleConvLayer(3, kernel_size=9), (1, 600, 512, 32))
     assert "conv_general_dilated" not in big_up
+
+
+def test_patches_conv_strided_stem_equals_conv():
+    """Strided PatchesConv (stride=2, zero_pad=1 — the U-Net down0 form
+    behind ModelConfig.thin_stem) == nn.Conv k4 s2 pad1, forward and both
+    param grads, same param tree."""
+    from flax import linen as nn
+
+    from p2p_tpu.ops.conv import PatchesConv, normal_init
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    ref = nn.Conv(16, kernel_size=(4, 4), strides=(2, 2), padding=1,
+                  use_bias=True, kernel_init=normal_init())
+    pc = PatchesConv(16, kernel_size=4, stride=2, zero_pad=1, use_bias=True,
+                     kernel_init=normal_init())
+    v = ref.init(jax.random.key(0), x)
+    yr, yp = ref.apply(v, x), pc.apply(v, x)
+    assert yp.shape == yr.shape
+    np.testing.assert_allclose(np.asarray(yp), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+
+    gr = jax.grad(lambda p: jnp.sum(jnp.square(ref.apply(p, x))))(v)
+    gp = jax.grad(lambda p: jnp.sum(jnp.square(pc.apply(p, x))))(v)
+    for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(gp)):
+        scale = max(float(np.abs(np.asarray(a)).max()), 1.0)
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=2e-5 * scale)
+
+
+def test_unet_thin_stem_matches_default():
+    """thin_stem U-Net == default U-Net on the same params (the dispatch
+    only reroutes down0's compute; param tree unchanged)."""
+    from p2p_tpu.models.unet import UNetGenerator
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.uniform(-1, 1, (2, 32, 32, 3)), jnp.float32)
+    base = UNetGenerator(ngf=8)
+    thin = UNetGenerator(ngf=8, thin_stem=True)
+    v = base.init(jax.random.key(1), x, False)
+    yb = base.apply(v, x, False)
+    yt = thin.apply(v, x, False)
+    np.testing.assert_allclose(np.asarray(yt), np.asarray(yb),
+                               rtol=1e-5, atol=1e-5)
