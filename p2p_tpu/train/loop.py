@@ -154,6 +154,19 @@ class Trainer:
         self.mesh = mesh if mesh is not None else (
             make_mesh(cfg.parallel.mesh) if use_mesh else None
         )
+        if self.mesh is not None:
+            from p2p_tpu.core.mesh import MODEL_AXIS, PIPE_AXIS
+
+            for ax in (MODEL_AXIS, PIPE_AXIS):
+                if self.mesh.shape.get(ax, 1) > 1:
+                    # training still runs correctly (the axis is just
+                    # replicated) but those devices do duplicate work
+                    print(
+                        f"WARNING: mesh axis {ax!r}={self.mesh.shape[ax]}: "
+                        "the CLI trainer shards over data/spatial/time "
+                        "only — use parallel/tp.py (state_sharding) or "
+                        "parallel/pp.py APIs to actually exploit it",
+                        flush=True)
         self.batch_sharding = batch_sharding(self.mesh) if self.mesh else None
         # Multi-host input: each process loads 1/process_count of the
         # GLOBAL batch (Grain shards records per process; device_prefetch
