@@ -13,11 +13,16 @@ previous state) and a single host fetch of the final loss forces the whole
 chain — ``jax.block_until_ready`` does not reliably fence on the tunneled
 'axon' platform, and per-step fetches would bill one tunnel round-trip per
 step. The RTT of a trivial fetch is measured separately and subtracted.
+The mechanics live in ``p2p_tpu.obs.timing`` (``StepTimer.chain`` +
+``measure_rtt``), so this file, the train loop, and the metrics stream all
+share ONE fenced img/sec/chip definition.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Env knobs: BENCH_PRESET, BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG.
+Env knobs: BENCH_PRESET, BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG;
+BENCH_JSONL=<path> additionally appends the record (kind="bench") to that
+metrics stream through the obs registry.
 """
 
 from __future__ import annotations
@@ -25,7 +30,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import time
 
 
 def main() -> None:
@@ -172,24 +176,27 @@ def main() -> None:
             cfg, vgg_params, train_dtype=dtype,
             unroll=int(os.environ.get("BENCH_UNROLL", "1")))
 
+    from p2p_tpu.obs import StepTimer, measure_rtt, span
+
     # tunnel round-trip cost of one trivial fetch
-    trivial = jax.jit(lambda v: v + 1)
-    float(trivial(jnp.ones(())))
-    t0 = time.perf_counter()
-    float(trivial(jnp.ones(())))
-    rtt = time.perf_counter() - t0
+    rtt = measure_rtt()
 
     # warmup (compile) + fence
-    state, metrics = step(state, batches)
-    float(metrics["loss_g"][-1])
-
-    t0 = time.perf_counter()
-    for _ in range(n_calls):
+    with span("bench_warmup"):
         state, metrics = step(state, batches)
-    float(metrics["loss_g"][-1])  # forces the whole chained sequence
-    elapsed = max(time.perf_counter() - t0 - rtt, 1e-9)
+        float(metrics["loss_g"][-1])
 
-    img_per_sec = bs * max(n_frames, 1) * scan_k * n_calls / elapsed
+    # the chained fenced interval, minus RTT — StepTimer.chain is the
+    # same accumulator the per-step tick() path feeds, so this number and
+    # the train loop's are the one img/sec/chip definition
+    timer = StepTimer(batch_size=bs * max(n_frames, 1))
+    with span("bench_timed"), timer.chain(
+            steps=scan_k * n_calls, rtt=rtt) as ch:
+        for _ in range(n_calls):
+            state, metrics = step(state, batches)
+        ch.fence(metrics["loss_g"][-1])  # forces the whole chained sequence
+
+    img_per_sec = timer.images_per_sec
     baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 pix2pix
     comparable = on_tpu and img == 256 and preset in (
         "facades", "facades_int8", "edges2shoes_dp",
@@ -215,6 +222,16 @@ def main() -> None:
         if "v5 lite" in kind.lower() or "v5e" in kind.lower():
             record["v4_equiv_at_same_efficiency"] = round(
                 img_per_sec * 275.0 / 197.0, 2)
+    if os.environ.get("BENCH_JSONL"):
+        # mirror the result into a metrics stream (same record, kind-tagged)
+        from p2p_tpu.obs import JSONLSink, MetricsRegistry
+
+        reg = MetricsRegistry()
+        sink = JSONLSink(os.environ["BENCH_JSONL"])
+        reg.add_sink(sink)
+        reg.record({"kind": "bench", "rtt_sec": round(rtt, 6), **record},
+                   force=True)
+        sink.close()
     print(json.dumps(record))
 
 

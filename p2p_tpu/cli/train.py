@@ -70,6 +70,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="keep the dead conv biases in front of norm "
                         "layers (round-2 checkpoint layout; see "
                         "ModelConfig.legacy_layout)")
+    # --- telemetry / debug knobs (p2p_tpu.obs) ----------------------------
+    p.add_argument("--check_finite", action="store_true", default=None,
+                   help="host-side non-finite guard on the step metrics "
+                        "after every dispatch: emits a kind=nonfinite "
+                        "record, then raises (fences each dispatch — "
+                        "debug tool)")
+    p.add_argument("--nan_sentinel", action="store_true", default=None,
+                   help="in-jit NaN/Inf sentinel on the step losses via "
+                        "jax.debug.callback (async, no fence on the "
+                        "happy path) — events land in the metrics JSONL")
+    p.add_argument("--grad_norms", action="store_true", default=None,
+                   help="add grad_norm_g/d global-norm scalars to the "
+                        "per-step metrics stream")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also write scalar records to TensorBoard event "
+                        "files under <workdir>/tb/<name>")
+    p.add_argument("--prom_textfile", type=str, default=None,
+                   help="export registry metrics in Prometheus textfile "
+                        "format to this path (atomic rewrite; point "
+                        "node_exporter's textfile collector at its dir)")
     # --- reference flags (train.py:133-157), same names/defaults ---------
     p.add_argument("--dataset", type=str, default=None, help="facades")
     p.add_argument("--name", type=str, default=None, help="training name")
@@ -139,6 +159,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="train steps fused into one lax.scan dispatch "
                         "(amortizes host/tunnel latency; metrics are still "
                         "logged per step)")
+    p.add_argument("--log_every", type=int, default=None,
+                   help="per-step metrics record + stdout heartbeat cadence "
+                        "(TrainConfig.log_every; epoch/eval records are "
+                        "always written)")
     p.add_argument("--phase", choices=["global", "full"], default=None,
                    help="pix2pixHD coarse-to-fine schedule: 'global' trains "
                         "G1 alone at half resolution (checkpoints under "
@@ -187,7 +211,10 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     train = over(train, nepoch=args.nepoch, epoch_count=args.epoch_count,
                  epoch_save=args.epochsave, seed=args.seed,
                  eval_fid=args.eval_fid, scan_steps=args.scan_steps,
-                 pool_size=args.pool_size, save_masks=args.save_masks)
+                 pool_size=args.pool_size, save_masks=args.save_masks,
+                 log_every=args.log_every)
+    debug = over(cfg.debug, check_finite=args.check_finite,
+                 nan_sentinel=args.nan_sentinel, grad_norms=args.grad_norms)
     if args.mesh is not None:
         from p2p_tpu.core.mesh import MeshSpec
 
@@ -213,7 +240,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     name = args.name or cfg.name
     cfg = dataclasses.replace(
         cfg, name=name, model=model, loss=loss, optim=optim, data=data,
-        train=train, parallel=par,
+        train=train, parallel=par, debug=debug,
     )
     if getattr(args, "phase", None) == "global":
         # coarse-to-fine phase 1 — applied AFTER flag overrides so an
@@ -238,6 +265,22 @@ def main(argv=None) -> int:
         from p2p_tpu.train.loop import Trainer
 
     trainer = Trainer(cfg, data_root=args.data_root, workdir=args.workdir)
+    if args.tensorboard:
+        import os
+
+        from p2p_tpu.obs import TensorBoardSink
+
+        try:
+            trainer.logger.registry.add_sink(
+                TensorBoardSink(os.path.join(args.workdir, "tb", cfg.name)))
+        except ImportError as e:
+            print(f"note: --tensorboard unavailable ({e}); continuing "
+                  "with JSONL/stdout only", file=sys.stderr)
+    if args.prom_textfile:
+        from p2p_tpu.obs import PrometheusTextfileSink
+
+        trainer.logger.registry.add_sink(PrometheusTextfileSink(
+            args.prom_textfile, trainer.logger.registry))
     resumed = trainer.maybe_resume()
     if resumed:
         print(f"resumed at epoch {trainer.epoch}")
@@ -250,7 +293,10 @@ def main(argv=None) -> int:
             trainer.state, cfg, workdir=args.workdir,
             g1_dir=args.init_g1_from, mesh=getattr(trainer, "mesh", None),
         )
-    trainer.fit()
+    try:
+        trainer.fit()
+    finally:
+        trainer.close()  # unhook compile listener + sentinel handler
     return 0
 
 

@@ -248,6 +248,25 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DebugConfig:
+    """Numerical/telemetry debug taps (p2p_tpu.obs; all off by default —
+    the happy path pays nothing)."""
+
+    # Host-side post-dispatch guard over the step metrics (core/debug.
+    # check_finite): emits a kind="nonfinite" record into the metrics
+    # stream, then raises. Fetches the metrics every dispatch — a fence;
+    # debugging flag, not a production default.
+    check_finite: bool = False
+    # In-jit NaN/Inf sentinel over the step metrics via jax.debug.callback
+    # (obs/taps.py): async device→host counts, NO fence on the happy path.
+    # Cheap enough to leave on in production when chasing instabilities.
+    nan_sentinel: bool = False
+    # Add grad_norm_g / grad_norm_d global-norm scalars to the step metrics
+    # (they ride the metrics fetch the loop already pays for).
+    grad_norms: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     name: str = "default"
     model: ModelConfig = ModelConfig()
@@ -256,6 +275,7 @@ class Config:
     data: DataConfig = DataConfig()
     parallel: ParallelConfig = ParallelConfig()
     train: TrainConfig = TrainConfig()
+    debug: DebugConfig = DebugConfig()
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)

@@ -6,12 +6,16 @@ entirely, so this is the sanitizer surface).
 - :func:`enable_nan_debugging` — turn on ``jax_debug_nans`` so the first
   NaN-producing primitive raises with its location (re-runs the op
   un-jitted; debugging tool, not a production guard).
-- :func:`check_finite` — host-side pytree guard for post-step use.
+- :func:`check_finite` — host-side pytree guard, wired into the train loop
+  behind ``cfg.debug.check_finite``: emits a ``kind="nonfinite"`` record
+  into the telemetry stream (so the evidence survives the crash) and then
+  raises. The fence-free in-jit variant is
+  :func:`p2p_tpu.obs.taps.nan_sentinel`.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Dict, List
 
 import jax
 import numpy as np
@@ -21,13 +25,39 @@ def enable_nan_debugging(enable: bool = True) -> None:
     jax.config.update("jax_debug_nans", enable)
 
 
-def check_finite(tree: Any, name: str = "tree") -> None:
-    """Raise FloatingPointError naming the first non-finite leaf."""
+def find_nonfinite(tree: Any) -> List[Dict[str, int]]:
+    """Host-side scan of a pytree for non-finite floats; returns one
+    ``{"leaf": path, "nan": n, "inf": n}`` entry per offending leaf.
+    Fetches every leaf — a fence; use only behind a debug flag or on
+    already-fetched host values."""
+    out = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         arr = np.asarray(leaf)
         if np.issubdtype(arr.dtype, np.floating) and not np.all(np.isfinite(arr)):
             keys = "/".join(str(getattr(p, "key", p)) for p in path)
-            raise FloatingPointError(
-                f"non-finite values in {name}:{keys} "
-                f"(nan={int(np.isnan(arr).sum())}, inf={int(np.isinf(arr).sum())})"
-            )
+            out.append({"leaf": keys, "nan": int(np.isnan(arr).sum()),
+                        "inf": int(np.isinf(arr).sum())})
+    return out
+
+
+def check_finite(tree: Any, name: str = "tree", registry=None,
+                 raise_: bool = True) -> List[Dict[str, int]]:
+    """Guard a pytree: emit a telemetry event for non-finite leaves, then
+    raise ``FloatingPointError`` naming the first one (unless ``raise_`` is
+    False, for callers that degrade instead of dying). ``registry`` is a
+    :class:`p2p_tpu.obs.MetricsRegistry` (or anything with ``.record``)."""
+    findings = find_nonfinite(tree)
+    if not findings:
+        return findings
+    if registry is not None:
+        registry.record(
+            {"kind": "nonfinite", "name": name, "leaves": findings},
+            force=True,
+        )
+    if raise_:
+        f = findings[0]
+        raise FloatingPointError(
+            f"non-finite values in {name}:{f['leaf']} "
+            f"(nan={f['nan']}, inf={f['inf']})"
+        )
+    return findings

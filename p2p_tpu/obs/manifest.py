@@ -1,0 +1,85 @@
+"""Run manifest — the provenance record written once at startup.
+
+One JSON file per run answering "what exactly produced these numbers":
+the full config (plus its hash, so runs are comparable by one string), the
+git SHA of the tree, the mesh shape, the dtype policy, and the JAX/device
+inventory. Written atomically; multi-host runs write from process 0 only
+(callers gate) with per-process info included for debugging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:
+        return None
+
+
+def config_hash(cfg) -> str:
+    """Stable short hash of a (nested, frozen) Config dataclass."""
+    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def build_manifest(cfg, mesh=None,
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    devices = jax.devices()
+    man: Dict[str, Any] = {
+        "kind": "manifest",
+        "name": getattr(cfg, "name", None),
+        "config_hash": config_hash(cfg),
+        "config": dataclasses.asdict(cfg),
+        "git_sha": _git_sha(),
+        "argv": list(sys.argv),
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "jax_version": jax.__version__,
+        "backend": devices[0].platform if devices else None,
+        "device_kind": devices[0].device_kind if devices else None,
+        "n_devices": len(devices),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "mesh_shape": dict(mesh.shape) if mesh is not None else None,
+        # dtype policy: compute dtype of the jitted step + optimizer moments
+        "dtype_policy": {
+            "compute": ("bfloat16" if cfg.train.mixed_precision
+                        else "float32"),
+            "params": "float32",
+            "adam_moments": cfg.optim.moment_dtype or "float32",
+            "input_pipeline": ("uint8" if cfg.data.uint8_pipeline
+                               else "float32"),
+        },
+    }
+    if extra:
+        man.update(extra)
+    return man
+
+
+def write_manifest(path: str, cfg, mesh=None,
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    man = build_manifest(cfg, mesh=mesh, extra=extra)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1, default=str)
+    os.replace(tmp, path)
+    return man

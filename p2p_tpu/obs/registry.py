@@ -1,0 +1,362 @@
+"""Process-wide metrics registry — counters, gauges, histograms, EWMA rates.
+
+The reference's observability was a tqdm bar (SURVEY §5.1); the seed's was a
+JSONL logger welded into the train loop. This registry is the one place every
+subsystem reports through: metric objects are cheap host-side accumulators
+keyed by (name, tags), structured records fan out to pluggable sinks
+(:mod:`p2p_tpu.obs.sinks`), and :meth:`MetricsRegistry.aggregate` reduces a
+snapshot across JAX processes so multi-host runs report ONE set of numbers.
+
+Nothing here touches devices: in-jit values reach the registry either as
+already-fetched host floats (the train loop's ``log`` path) or through the
+async ``jax.debug.callback`` taps in :mod:`p2p_tpu.obs.taps`.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+Tags = Tuple[Tuple[str, str], ...]
+
+
+def _tags_key(tags: Dict[str, Any]) -> Tags:
+    return tuple(sorted((k, str(v)) for k, v in tags.items()))
+
+
+class Counter:
+    """Monotonic count (events, images, retraces). Cross-host reduce: sum."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, tags: Tags = ()):
+        self.name, self.tags = name, tags
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"value": self._value}
+
+
+class Gauge:
+    """Last-written level (lr, HBM bytes, pool fill). Cross-host: mean+max."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, tags: Tags = ()):
+        self.name, self.tags = name, tags
+        self._value = float("nan")
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"value": self._value}
+
+
+class Histogram:
+    """Streaming distribution over fixed log-spaced buckets.
+
+    Default buckets span 1 µs .. ~1000 s in half-decade steps — sized for
+    wall-clock durations, the dominant histogram use. ``observe`` is O(log B);
+    count/sum/min/max are exact, quantiles are bucket-resolution estimates.
+    Cross-host reduce: bucket-wise sum (count/sum add; min/max min/max).
+    """
+
+    kind = "histogram"
+    DEFAULT_BOUNDS = tuple(
+        10.0 ** (e / 2.0) for e in range(-12, 7)
+    )  # 1e-6 .. ~1e3
+
+    def __init__(self, name: str, tags: Tags = (),
+                 bounds: Optional[Iterable[float]] = None):
+        self.name, self.tags = name, tags
+        self.bounds = tuple(bounds) if bounds is not None else self.DEFAULT_BOUNDS
+        self.buckets = [0] * (len(self.bounds) + 1)  # last = +inf overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        import bisect
+
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.buckets[i] += 1
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else float("nan")
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (upper bound of the bucket
+        holding the q-th observation)."""
+        if not self.count:
+            return float("nan")
+        target = q * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return self.bounds[i] if i < len(self.bounds) else self.max
+        return self.max
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"count": float(self.count), "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "p50": self.quantile(0.5), "p99": self.quantile(0.99)}
+
+
+class EWMARate:
+    """Exponentially-weighted event rate (img/sec, records/sec).
+
+    ``mark(n)`` credits n events; the rate is an EWMA of per-interval rates
+    with the given half-life in seconds, so a stall decays visibly instead of
+    being averaged away by a long warm history. Cross-host reduce: sum (rates
+    add across hosts — each host pushes its own shard of the global batch).
+    """
+
+    kind = "ewma"
+
+    def __init__(self, name: str, tags: Tags = (), halflife_s: float = 30.0,
+                 clock=time.monotonic):
+        self.name, self.tags = name, tags
+        self.halflife_s = halflife_s
+        self._clock = clock
+        self._rate = float("nan")
+        self._t_last: Optional[float] = None
+
+    def mark(self, n: float = 1.0) -> None:
+        now = self._clock()
+        if self._t_last is None:
+            self._t_last = now
+            return
+        dt = max(now - self._t_last, 1e-9)
+        self._t_last = now
+        inst = n / dt
+        if math.isnan(self._rate):
+            self._rate = inst
+        else:
+            alpha = 1.0 - 0.5 ** (dt / self.halflife_s)
+            self._rate += alpha * (inst - self._rate)
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"rate": self._rate}
+
+
+# Reduction rule per metric kind for the cross-host combine: each entry maps
+# snapshot-field -> reducer over the per-host column.
+_REDUCERS = {
+    "counter": {"value": sum},
+    "gauge": {"value_mean": None, "value_max": None},  # special-cased below
+    "ewma": {"rate": sum},
+    "histogram": {"count": sum, "sum": sum, "min": min, "max": max},
+}
+
+
+def combine_host_snapshots(rows: List[Dict[str, Dict[str, float]]],
+                           kinds: Dict[str, str]) -> Dict[str, Dict[str, float]]:
+    """Pure combine of per-host ``snapshot()`` dicts — unit-testable without
+    a multi-host runtime. ``rows[i]`` is host i's ``{metric_key: fields}``;
+    ``kinds`` maps metric_key -> metric kind. Metrics missing on some host
+    (e.g. a sentinel that only fired on one) combine over the hosts that
+    have them."""
+    out: Dict[str, Dict[str, float]] = {}
+    for key, kind in kinds.items():
+        cols = [r[key] for r in rows if key in r]
+        if not cols:
+            continue
+        if kind == "gauge":
+            vals = [c["value"] for c in cols if not math.isnan(c["value"])]
+            out[key] = {
+                "value_mean": sum(vals) / len(vals) if vals else float("nan"),
+                "value_max": max(vals) if vals else float("nan"),
+            }
+            continue
+        fields = {}
+        for f, red in _REDUCERS[kind].items():
+            vals = [c[f] for c in cols if f in c]
+            if vals:
+                fields[f] = red(vals)
+        if kind == "histogram" and fields.get("count"):
+            fields["mean"] = fields["sum"] / fields["count"]
+        out[key] = fields
+    return out
+
+
+class MetricsRegistry:
+    """Metric factory + record bus.
+
+    - ``counter/gauge/histogram/ewma(name, **tags)`` get-or-create a metric
+      (idempotent per (name, tags) — safe to call in hot loops).
+    - ``record(payload, force=)`` stamps and fans a structured record out to
+      every attached sink (the JSONL/stdout/TensorBoard/Prometheus writers).
+    - ``snapshot()/aggregate()`` expose the metric state for exporters and
+      cross-process reduction.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[Tuple[str, Tags], Any] = {}
+        self._sinks: List[Any] = []
+        self._lock = threading.Lock()
+
+    # -- metric factories --------------------------------------------------
+    def _get(self, cls, name: str, tags: Dict[str, Any], **kw):
+        key = (name, _tags_key(tags))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, key[1], **kw)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **tags) -> Counter:
+        return self._get(Counter, name, tags)
+
+    def gauge(self, name: str, **tags) -> Gauge:
+        return self._get(Gauge, name, tags)
+
+    def histogram(self, name: str, bounds=None, **tags) -> Histogram:
+        return self._get(Histogram, name, tags, bounds=bounds)
+
+    def ewma(self, name: str, halflife_s: float = 30.0, **tags) -> EWMARate:
+        return self._get(EWMARate, name, tags, halflife_s=halflife_s)
+
+    # -- record bus --------------------------------------------------------
+    def add_sink(self, sink) -> None:
+        self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        if sink in self._sinks:
+            self._sinks.remove(sink)
+
+    @property
+    def sinks(self):
+        return tuple(self._sinks)
+
+    def record(self, payload: Dict[str, Any], force: bool = False) -> None:
+        """Fan a structured record (a flat dict with a ``kind`` field, e.g.
+        the per-step/eval/epoch records of the train loop) out to every sink.
+        Device scalars are coerced to host floats here so sinks never hold
+        device references alive."""
+        rec = {
+            k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float))
+                else v)
+            for k, v in payload.items()
+        }
+        rec.setdefault("ts", round(time.time(), 3))
+        for s in self._sinks:
+            s.write(rec, force=force)
+
+    def flush(self) -> None:
+        for s in self._sinks:
+            s.flush()
+
+    def close(self) -> None:
+        for s in self._sinks:
+            s.close()
+
+    # -- snapshots & cross-host aggregation --------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {}
+        for (name, tags), m in items:
+            key = name + ("{" + ",".join(f"{k}={v}" for k, v in tags) + "}"
+                          if tags else "")
+            out[key] = m.snapshot()
+        return out
+
+    def kinds(self) -> Dict[str, str]:
+        with self._lock:
+            items = list(self._metrics.items())
+        return {
+            name + ("{" + ",".join(f"{k}={v}" for k, v in tags) + "}"
+                    if tags else ""): m.kind
+            for (name, tags), m in items
+        }
+
+    def aggregate(self) -> Dict[str, Dict[str, float]]:
+        """Cross-process reduction of the snapshot. On one process this is
+        the snapshot itself (combined through the same pure path, so the
+        field names match multi-host output). Every process must call this
+        together — it enters collectives on >1 process.
+
+        Key sets may DIFFER across processes (a sentinel counter exists
+        only where it fired), so snapshots are exchanged as length-padded
+        JSON blobs — two fixed-shape allgathers — rather than a dense
+        sorted-key array that would misalign or go ragged.
+        """
+        import jax
+
+        snap = self.snapshot()
+        kinds = self.kinds()
+        if jax.process_count() == 1:
+            return combine_host_snapshots([snap], kinds)
+        import json
+
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        blob = json.dumps([snap, kinds]).encode()
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.array([len(blob)], np.int64))).reshape(-1)
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[: len(blob)] = np.frombuffer(blob, np.uint8)
+        rows = np.asarray(multihost_utils.process_allgather(buf))
+        rows = rows.reshape(len(lens), -1)
+        host_rows, all_kinds = [], {}
+        for r, n in zip(rows, lens):
+            s, k = json.loads(bytes(r[: int(n)]).decode())
+            host_rows.append(s)
+            all_kinds.update(k)
+        return combine_host_snapshots(host_rows, all_kinds)
+
+
+_default_registry: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (created on first use). Components
+    that cannot be handed one explicitly — the in-jit sentinel callbacks,
+    the compile watchdog — report here."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry
+
+
+def set_registry(reg: Optional[MetricsRegistry]) -> Optional[MetricsRegistry]:
+    """Swap the process default (tests); returns the previous one."""
+    global _default_registry
+    with _default_lock:
+        prev = _default_registry
+        _default_registry = reg
+        return prev

@@ -1,0 +1,117 @@
+"""In-jit telemetry taps — NaN/Inf sentinels and grad-norm scalars.
+
+The reference's only recurring failure mode is numerical (SURVEY §5.2:
+Inf-PSNR clamping, ``isnan`` guards); the seed's answer was a host-side
+``check_finite`` that nothing called. These taps put the guard INSIDE the
+jitted step without fencing it:
+
+- :func:`nan_sentinel` counts non-finite entries of a pytree in-graph (a
+  per-leaf ``isnan``/``isinf`` reduction — tiny for the metrics dict it
+  guards) and ships the counts to the host through ``jax.debug.callback``.
+  Unordered callbacks don't serialize the program: the device-to-host copy
+  rides the async stream, so the happy path gains no fence — only the small
+  reduction. Works under ``lax.scan`` (the multi-step path) and donation.
+- :func:`grad_norm_taps` adds global-norm scalars for the step's gradient
+  trees to the metrics dict (they come home with the metrics fetch the loop
+  already pays for).
+
+When a sentinel fires it increments ``nonfinite_events`` on the process
+registry and calls every registered handler (the Trainer registers one that
+writes a ``kind="sentinel"`` record into the metrics JSONL).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_handlers: List[Callable[[Dict[str, Any]], None]] = []
+_handlers_lock = threading.Lock()
+
+
+def add_sentinel_handler(fn: Callable[[Dict[str, Any]], None]) -> None:
+    with _handlers_lock:
+        if fn not in _handlers:
+            _handlers.append(fn)
+
+
+def remove_sentinel_handler(fn) -> None:
+    with _handlers_lock:
+        if fn in _handlers:
+            _handlers.remove(fn)
+
+
+def _leaf_name(path) -> str:
+    return ("/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path) or "leaf")
+
+
+def _on_counts(counts, *, tag: str, names: tuple) -> None:
+    counts = np.asarray(counts)
+    if counts.sum() == 0:  # happy path: nothing to report
+        return
+    from p2p_tpu.obs.registry import get_registry
+
+    bad = {
+        names[i]: {"nan": int(counts[i, 0]), "inf": int(counts[i, 1])}
+        for i in range(len(names))
+        if counts[i].sum()
+    }
+    event = {"kind": "sentinel", "tag": tag,
+             "nan": int(counts[:, 0].sum()), "inf": int(counts[:, 1].sum()),
+             "leaves": bad}
+    get_registry().counter("nonfinite_events", tag=tag).inc()
+    print(f"WARNING: non-finite values in {tag}: {bad}", flush=True)
+    with _handlers_lock:
+        handlers = list(_handlers)
+    for h in handlers:
+        try:
+            h(event)
+        except Exception as e:  # a dead handler must not kill the run
+            print(f"WARNING: sentinel handler failed: {e!r}", flush=True)
+
+
+def nan_sentinel(tree, tag: str = "tree") -> None:
+    """Trace-time: attach a non-finite sentinel to a pytree of arrays.
+
+    Call inside a jitted function. Costs one isnan+isinf reduction per
+    floating leaf plus an async (L, 2) int32 device→host copy; no fence.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names, rows = [], []
+    for path, leaf in flat:
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            continue
+        names.append(_leaf_name(path))
+        rows.append(jnp.stack([
+            jnp.sum(jnp.isnan(leaf), dtype=jnp.int32),
+            jnp.sum(jnp.isinf(leaf), dtype=jnp.int32),
+        ]))
+    if not rows:
+        return
+    counts = jnp.stack(rows)
+    import functools
+
+    jax.debug.callback(
+        functools.partial(_on_counts, tag=tag, names=tuple(names)), counts
+    )
+
+
+def grad_norm_taps(metrics: Dict[str, jax.Array],
+                   **grad_trees) -> Dict[str, jax.Array]:
+    """Add ``grad_norm_<key>`` global-norm scalars to a metrics dict.
+
+    In-graph and fence-free: the norms ride the metrics pytree the host was
+    going to fetch anyway. ``grad_norm_taps(metrics, g=grads_g, d=grads_d)``.
+    """
+    import optax
+
+    for key, tree in grad_trees.items():
+        if tree is not None:
+            metrics[f"grad_norm_{key}"] = optax.global_norm(tree).astype(
+                jnp.float32)
+    return metrics

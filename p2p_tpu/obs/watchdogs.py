@@ -1,0 +1,124 @@
+"""Runtime watchdogs: unexpected-recompile detection and HBM sampling.
+
+RetraceWatchdog
+    A silent recompile mid-training is the classic JAX perf bug: a shape or
+    dtype wobble (an odd tail batch reaching the scanned path, a python
+    float flipping a weak dtype) recompiles a minute-scale XLA program and
+    the step time graph grows a mystery cliff. The watchdog listens to
+    ``jax.monitoring``'s backend-compile duration events (process-wide —
+    every jit, pjit, and pallas call funnels through them); after ``arm()``
+    (call it once warmup compiles are done, e.g. after the first epoch)
+    any further compile is counted, logged as a ``kind="retrace"`` record,
+    and printed.
+
+MemoryWatchdog
+    Samples ``Device.memory_stats()`` per local device into gauges — the
+    HBM fill/peak numbers that tell you how close a preset is to the OOM
+    cliff. CPU backends report nothing; ``sample()`` returns {} there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+# Fires once per XLA backend compile (empirically present on the CPU and TPU
+# runtimes of the pinned jax; registration is version-guarded regardless).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RetraceWatchdog:
+    """Count backend compiles; warn on any that happen after ``arm()``."""
+
+    def __init__(self, registry=None, logger=None):
+        self.registry = registry
+        self.logger = logger            # optional MetricsLogger for records
+        self.compiles = 0               # total since construction
+        self.unexpected = 0             # compiles seen while armed
+        self.armed = False
+        self._registered = False
+        try:
+            from jax._src import monitoring as _mon
+
+            self._mon = _mon
+            _mon.register_event_duration_secs_listener(self._on_event)
+            self._registered = True
+        except Exception:               # jax moved the private API: degrade
+            self._mon = None
+
+    # NOTE: listener signature is (event, duration, **kwargs) in the pinned
+    # jax; absorb extras so minor-version drift doesn't raise in a callback.
+    def _on_event(self, event: str, duration: float, **kw) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        self.compiles += 1
+        reg = self.registry
+        if reg is not None:
+            reg.counter("xla_compiles").inc()
+            reg.histogram("xla_compile_secs").observe(duration)
+        if self.armed:
+            self.unexpected += 1
+            if reg is not None:
+                reg.counter("unexpected_recompiles").inc()
+            rec = {"kind": "retrace", "compile_secs": round(duration, 3),
+                   "n_unexpected": self.unexpected}
+            if self.logger is not None:
+                try:
+                    self.logger.log(rec, force=True)
+                except Exception:
+                    pass
+            print(f"WARNING: unexpected XLA recompile "
+                  f"#{self.unexpected} ({duration:.2f}s) — check for "
+                  "shape/dtype wobble in the input pipeline", flush=True)
+
+    def arm(self) -> None:
+        """Call once expected warmup compiles are done; later compiles are
+        flagged as unexpected."""
+        self.armed = True
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    def close(self) -> None:
+        if self._registered and self._mon is not None:
+            try:
+                self._mon._unregister_event_duration_listener_by_callback(
+                    self._on_event)
+            except Exception:
+                pass
+            self._registered = False
+
+
+class MemoryWatchdog:
+    """Per-device HBM statistics into gauges + a ``kind="memory"`` record."""
+
+    def __init__(self, registry=None):
+        self.registry = registry
+
+    def sample(self, logger=None) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            keep = {
+                k: int(v) for k, v in stats.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit", "largest_alloc_size")
+            }
+            if not keep:
+                continue
+            out[str(d.id)] = keep
+            if self.registry is not None:
+                for k, v in keep.items():
+                    self.registry.gauge(f"hbm_{k}", device=d.id).set(v)
+        if out and logger is not None:
+            worst = max(out.values(),
+                        key=lambda s: s.get("bytes_in_use", 0))
+            logger.log({"kind": "memory", "n_devices": len(out), **worst},
+                       force=True)
+        return out

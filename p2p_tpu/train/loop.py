@@ -9,14 +9,18 @@ metric space, checkpoints that restore).
 TPU structure: ONE jitted step per iteration, host code only moves batches
 (via the double-buffered prefetcher) and logs; metrics come back as a small
 dict so the device never syncs mid-epoch unless asked.
+
+Telemetry goes through :mod:`p2p_tpu.obs`: the JSONL/stdout ``MetricsLogger``
+(formerly defined here), a per-run manifest written at startup, wall-clock
+spans exported as Perfetto JSON at the end of ``fit()``, a recompile
+watchdog armed after the warmup epoch, and per-device HBM sampling.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
@@ -25,6 +29,14 @@ from p2p_tpu.core.config import Config
 from p2p_tpu.core.mesh import local_batch_size, batch_sharding, make_mesh
 from p2p_tpu.data.pipeline import PairedImageDataset, device_prefetch, make_loader
 from p2p_tpu.models.vgg import load_vgg19_params
+from p2p_tpu.obs import (
+    MemoryWatchdog,
+    MetricsLogger,
+    RetraceWatchdog,
+    SpanRecorder,
+    add_sentinel_handler,
+    write_manifest,
+)
 from p2p_tpu.train.checkpoint import CheckpointManager
 from p2p_tpu.train.schedules import PlateauController
 from p2p_tpu.train.state import create_train_state
@@ -32,34 +44,57 @@ from p2p_tpu.train.step import build_eval_step, build_train_step
 from p2p_tpu.utils.images import ingest, save_img
 
 
-class MetricsLogger:
-    """JSONL metrics log + stdout heartbeat (the reference's tqdm bar and
-    print statements, structured — SURVEY §5.5)."""
+def init_trainer_obs(tr) -> None:
+    """Shared telemetry wiring for both trainers (p2p_tpu.obs): run manifest
+    + provenance record, span recorder + trace path, recompile/HBM
+    watchdogs, smoothed dispatch-rate EWMA, and sentinel-event routing into
+    the run's metrics stream. ``tr`` needs cfg/workdir/mesh/logger/obs."""
+    cfg = tr.cfg
+    tr.spans = SpanRecorder()
+    tr._trace_path = os.path.join(tr.workdir, f"trace_{cfg.name}.json")
+    if jax.process_index() == 0:
+        man = write_manifest(
+            os.path.join(tr.workdir, f"manifest_{cfg.name}.json"),
+            cfg, mesh=tr.mesh,
+        )
+        # one line of provenance into the metrics stream too, so a bare
+        # JSONL names the config that produced it
+        tr.logger.log(
+            {"kind": "manifest", "config_hash": man["config_hash"],
+             "git_sha": man["git_sha"], "backend": man["backend"]},
+            force=True,
+        )
+    tr.retrace = RetraceWatchdog(registry=tr.obs, logger=tr.logger)
+    tr.memwatch = MemoryWatchdog(registry=tr.obs)
+    tr._img_rate = tr.obs.ewma("img_dispatch_rate")
+    tr._sentinel_handler = None
+    if cfg.debug.nan_sentinel:
+        # route in-jit sentinel events (obs/taps.py) into this run's
+        # metrics stream and count them on THIS run's registry (the
+        # exporters snapshot tr.obs, not the process default). Capture
+        # logger/obs, not tr — the handler must not pin the TrainState.
+        logger, reg = tr.logger, tr.obs
 
-    def __init__(self, path: Optional[str] = None, print_every: int = 50):
-        self.path = path
-        self.print_every = print_every
-        self._f = open(path, "a") if path else None
+        def _handler(ev):
+            reg.counter("nonfinite_events", tag=ev.get("tag", "")).inc()
+            logger.log(ev, force=True)
 
-    def log(self, record: Dict[str, Any], force: bool = False) -> None:
-        rec = {
-            k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v)
-            for k, v in record.items()
-        }
-        if self._f:
-            self._f.write(json.dumps(rec) + "\n")
-            self._f.flush()
-        step = rec.get("step", 0)
-        if force or rec.get("kind") == "eval" or step % self.print_every == 0:
-            msg = " ".join(
-                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
-                for k, v in rec.items()
-            )
-            print(msg, flush=True)
+        tr._sentinel_handler = _handler
+        add_sentinel_handler(_handler)
 
-    def close(self):
-        if self._f:
-            self._f.close()
+
+def close_trainer_obs(tr) -> None:
+    """Tear down the process-global hooks ``init_trainer_obs`` installed —
+    the compile-event listener and the sentinel handler. Without this a
+    SECOND trainer in the same process (sweeps, phase global→full, tests)
+    would keep routing its compiles and NaN events into the FIRST run's
+    metrics stream. Idempotent; the CLI calls it after fit()."""
+    from p2p_tpu.obs import remove_sentinel_handler
+
+    tr.retrace.close()
+    if getattr(tr, "_sentinel_handler", None) is not None:
+        remove_sentinel_handler(tr._sentinel_handler)
+        tr._sentinel_handler = None
 
 
 def local_metric_rows(vec) -> np.ndarray:
@@ -247,10 +282,19 @@ class Trainer:
             os.path.join(workdir, f"metrics_{cfg.name}.jsonl"),
             cfg.train.log_every,
         )
+        self.obs = self.logger.registry
+        self._init_obs()
         self.plateau = (
             PlateauController() if cfg.optim.lr_policy == "plateau" else None
         )
         self.epoch = cfg.train.epoch_count
+
+    def _init_obs(self) -> None:
+        init_trainer_obs(self)
+
+    def close(self) -> None:
+        """Release process-global telemetry hooks (safe to call twice)."""
+        close_trainer_obs(self)
 
     def _with_mesh(self, fn):
         # Tracing happens inside the first CALL of a jitted fn, so
@@ -379,21 +423,48 @@ class Trainer:
         compile_skew = 0.0  # later first-compiles excluded from throughput
         seen_kinds: set = set()
         last_logged = 0
+        n_disp = 0
+        disp_hist = self.obs.histogram("dispatch_secs")
 
         def run(batch_or_stack, k):
-            nonlocal sums, count, t0, first_k, compile_skew, last_logged
+            nonlocal sums, count, t0, first_k, compile_skew, last_logged, \
+                n_disp
             t_call = time.perf_counter()
-            if k > 1:
-                self.state, metrics = self.multi_step(
-                    self.state, batch_or_stack
-                )
-                step_metrics = jax.tree_util.tree_map(
-                    lambda v: jax.numpy.sum(v, axis=0), metrics
-                )
-                last = jax.tree_util.tree_map(lambda v: v[-1], metrics)
+            # Every dispatch feeds the duration histogram and carries a
+            # TraceAnnotation; only each epoch's FIRST few land in the
+            # exported span ring — per-step spans would flood the 200k
+            # ring on long runs and evict the epoch/eval spans.
+            if n_disp < 4:
+                cm = self.spans.span("train_dispatch", steps=k,
+                                     histogram=disp_hist)
             else:
-                self.state, last = self.train_step(self.state, batch_or_stack)
-                step_metrics = last
+                from p2p_tpu.obs import timed_annotation
+
+                cm = timed_annotation("train_dispatch", disp_hist)
+            n_disp += 1
+            with cm:
+                if k > 1:
+                    self.state, metrics = self.multi_step(
+                        self.state, batch_or_stack
+                    )
+                    step_metrics = jax.tree_util.tree_map(
+                        lambda v: jax.numpy.sum(v, axis=0), metrics
+                    )
+                    last = jax.tree_util.tree_map(lambda v: v[-1], metrics)
+                else:
+                    self.state, last = self.train_step(
+                        self.state, batch_or_stack)
+                    step_metrics = last
+            self._img_rate.mark(k * cfg.data.batch_size)
+            if cfg.debug.check_finite:
+                # host-side guard (fences this dispatch): the nonfinite
+                # record lands in the metrics stream BEFORE the raise.
+                # Checked on the scan-axis SUM, not the last step's slice —
+                # summing propagates any intermediate step's NaN/Inf, so a
+                # transient blowup inside a K-step dispatch can't slip past
+                from p2p_tpu.core.debug import check_finite
+
+                check_finite(step_metrics, "step_metrics", registry=self.obs)
             if count > 0 and k not in seen_kinds:
                 # first use of this dispatch shape mid-epoch (e.g. the
                 # single-step remainder after scanned dispatches): the call
@@ -473,6 +544,10 @@ class Trainer:
         return out
 
     def evaluate(self, save_samples: bool = False) -> Dict[str, float]:
+        with self.spans.span("evaluate", epoch=self.epoch):
+            return self._evaluate(save_samples)
+
+    def _evaluate(self, save_samples: bool = False) -> Dict[str, float]:
         cfg = self.cfg
         # drop_remainder=False only on a single host: with multiple JAX
         # processes Grain's ShardByJaxProcess could hand hosts UNEQUAL
@@ -603,21 +678,24 @@ class Trainer:
         cfg = self.cfg
         nepoch = nepoch or cfg.train.nepoch
         history = []
+        first_epoch = self.epoch
         while self.epoch <= nepoch:
             t0 = time.time()
-            train_metrics = self.train_epoch(seed=self.epoch)
-            record = {"epoch": self.epoch, "sec": time.time() - t0,
-                      **train_metrics}
-            lr = self.current_lr()
-            if lr is not None:  # reference prints LR per epoch (networks.py:125)
-                record["lr"] = lr
-            if cfg.train.eval_every_epoch:
-                record.update(self.evaluate(save_samples=True))
+            with self.spans.span("epoch", epoch=self.epoch):
+                train_metrics = self.train_epoch(seed=self.epoch)
+                record = {"epoch": self.epoch, "sec": time.time() - t0,
+                          **train_metrics}
+                lr = self.current_lr()
+                if lr is not None:  # reference prints LR per epoch (networks.py:125)
+                    record["lr"] = lr
+                if cfg.train.eval_every_epoch:
+                    record.update(self.evaluate(save_samples=True))
             history.append(record)
             # epoch summary (incl. lr) into the metrics stream — the
             # jsonl otherwise only carries per-step and eval records, so
             # LR continuity across a resume would be unobservable
             self.logger.log({"kind": "epoch", **record}, force=True)
+            self.memwatch.sample(self.logger)  # HBM fill/peak (no-op on CPU)
             if self.plateau is not None and "loss_g" in record:
                 # feed the generator loss, mode='min' (reference plateau);
                 # the returned scale multiplies every optimizer update
@@ -629,7 +707,19 @@ class Trainer:
                     lr_scale=jnp.asarray(scale, jnp.float32)
                 )
             if self.epoch % cfg.train.epoch_save == 0 or self.epoch == nepoch:
-                self.ckpt.save(int(self.state.step), self.state)
+                with self.spans.span("checkpoint_save", epoch=self.epoch):
+                    self.ckpt.save(int(self.state.step), self.state)
+            if self.epoch == first_epoch:
+                # warmup epoch compiled every dispatch shape (scan body,
+                # remainder, eval, comp_fn) — compiles from here on are
+                # suspect. The first async checkpoint save may still warn
+                # once; the watchdog only reports, never raises.
+                self.retrace.arm()
             self.epoch += 1
         self.ckpt.wait()
+        # Perfetto-loadable host-span trace next to the metrics stream
+        # (each fit() call rewrites it with the accumulated spans).
+        if jax.process_index() == 0:
+            self.spans.export_perfetto(self._trace_path)
+        self.logger.registry.flush()
         return history

@@ -457,6 +457,21 @@ def build_train_step(
             "loss_c": loss_c,
             **{k: v.astype(jnp.float32) for k, v in g_parts.items()},
         }
+        if cfg.debug.grad_norms:
+            # in-graph global norms; they ride the metrics fetch the loop
+            # already pays for — no extra sync
+            from p2p_tpu.obs.taps import grad_norm_taps
+
+            grad_norm_taps(metrics, g=grads_g, d=grads_d,
+                           c=grads_c if use_c else None)
+        if cfg.debug.nan_sentinel:
+            # async host callback (obs/taps.py): fires an obs event when a
+            # loss/metric goes non-finite; NO fence on the happy path.
+            # Also watches the effective update scale so loss-scale /
+            # plateau collapse is visible alongside the NaN itself.
+            from p2p_tpu.obs.taps import nan_sentinel
+
+            nan_sentinel({**metrics, "lr_scale": scale}, tag="train_step")
         if cfg.optim.grad_clip > 0:
             # the _zero_nonfinite guard silently drops inf/NaN gradient
             # entries; surface the count so a sustained blowup is visible

@@ -23,8 +23,9 @@ from p2p_tpu.data.pipeline import device_prefetch, make_loader
 from p2p_tpu.data.video import VideoClipDataset
 from p2p_tpu.losses import psnr, ssim
 from p2p_tpu.models.vgg import load_vgg19_params
+from p2p_tpu.obs import MetricsLogger
 from p2p_tpu.train.checkpoint import CheckpointManager
-from p2p_tpu.train.loop import MetricsLogger
+from p2p_tpu.train.loop import close_trainer_obs, init_trainer_obs
 from p2p_tpu.utils.images import ingest
 from p2p_tpu.train.video_step import (
     build_video_models,
@@ -116,7 +117,13 @@ class VideoTrainer:
             os.path.join(workdir, f"metrics_{cfg.name}.jsonl"),
             cfg.train.log_every,
         )
+        self.obs = self.logger.registry
+        init_trainer_obs(self)  # manifest + spans + watchdogs (p2p_tpu.obs)
         self.epoch = cfg.train.epoch_count
+
+    def close(self) -> None:
+        """Release process-global telemetry hooks (safe to call twice)."""
+        close_trainer_obs(self)
 
     def _build_step_fns(self) -> None:
         cfg = self.cfg
@@ -182,18 +189,37 @@ class VideoTrainer:
         t0 = time.perf_counter()
         K = cfg.train.scan_steps if self.multi_step is not None else 1
         last_logged = 0
+        n_disp = 0
+        disp_hist = self.obs.histogram("dispatch_secs")
 
         def run(batch, k):
-            nonlocal sums, count, t0, first_k, last_logged
-            if k > 1:
-                self.state, metrics = self.multi_step(self.state, batch)
-                step_metrics = jax.tree_util.tree_map(
-                    lambda v: jnp.sum(v, axis=0), metrics
-                )
-                last = jax.tree_util.tree_map(lambda v: v[-1], metrics)
+            nonlocal sums, count, t0, first_k, last_logged, n_disp
+            # first dispatches → span ring; all → histogram (cf. Trainer)
+            if n_disp < 4:
+                cm = self.spans.span("train_dispatch", steps=k,
+                                     histogram=disp_hist)
             else:
-                self.state, last = self.train_step(self.state, batch)
-                step_metrics = last
+                from p2p_tpu.obs import timed_annotation
+
+                cm = timed_annotation("train_dispatch", disp_hist)
+            n_disp += 1
+            with cm:
+                if k > 1:
+                    self.state, metrics = self.multi_step(self.state, batch)
+                    step_metrics = jax.tree_util.tree_map(
+                        lambda v: jnp.sum(v, axis=0), metrics
+                    )
+                    last = jax.tree_util.tree_map(lambda v: v[-1], metrics)
+                else:
+                    self.state, last = self.train_step(self.state, batch)
+                    step_metrics = last
+            self._img_rate.mark(k * cfg.data.batch_size * cfg.data.n_frames)
+            if cfg.debug.check_finite:
+                # scan-axis sum: catches an intermediate scanned step's
+                # NaN/Inf, not just the last slice (cf. Trainer)
+                from p2p_tpu.core.debug import check_finite
+
+                check_finite(step_metrics, "step_metrics", registry=self.obs)
             sums = step_metrics if sums is None else jax.tree_util.tree_map(
                 jnp.add, sums, step_metrics
             )
@@ -263,6 +289,10 @@ class VideoTrainer:
         return out
 
     def evaluate(self) -> Dict[str, float]:
+        with self.spans.span("evaluate", epoch=self.epoch):
+            return self._evaluate()
+
+    def _evaluate(self) -> Dict[str, float]:
         cfg = self.cfg
         loader = make_loader(
             self.test_ds, self.local_test_bs, shuffle=False,
@@ -327,18 +357,29 @@ class VideoTrainer:
         cfg = self.cfg
         nepoch = nepoch or cfg.train.nepoch
         history = []
+        first_epoch = self.epoch
         while self.epoch <= nepoch:
-            record = {"epoch": self.epoch, **self.train_epoch(seed=self.epoch)}
-            if cfg.train.eval_every_epoch:
-                record.update(self.evaluate())
+            with self.spans.span("epoch", epoch=self.epoch):
+                record = {"epoch": self.epoch,
+                          **self.train_epoch(seed=self.epoch)}
+                if cfg.train.eval_every_epoch:
+                    record.update(self.evaluate())
             history.append(record)
+            self.logger.log({"kind": "epoch", **record}, force=True)
+            self.memwatch.sample(self.logger)
             if self.plateau is not None and "loss_g" in record:
                 scale = self.plateau.update(record["loss_g"])
                 self.state = self.state.replace(
                     lr_scale=jnp.asarray(scale, jnp.float32)
                 )
             if self.epoch % cfg.train.epoch_save == 0 or self.epoch == nepoch:
-                self.ckpt.save(int(self.state.step), self.state)
+                with self.spans.span("checkpoint_save", epoch=self.epoch):
+                    self.ckpt.save(int(self.state.step), self.state)
+            if self.epoch == first_epoch:
+                self.retrace.arm()  # warmup compiles done; see Trainer.fit
             self.epoch += 1
         self.ckpt.wait()
+        if jax.process_index() == 0:
+            self.spans.export_perfetto(self._trace_path)
+        self.logger.registry.flush()
         return history

@@ -1,68 +1,10 @@
-"""Tracing / profiling utilities (SURVEY §5.1: the reference has none —
-tqdm bars and cudnn.benchmark were its whole observability story).
-
-- :func:`trace` — context manager around ``jax.profiler`` writing an XPlane
-  trace viewable in TensorBoard/XProf/Perfetto.
-- :func:`annotate` — named TraceAnnotation for host-side phases.
-- :class:`StepTimer` — fenced (block_until_ready) step timing with an
-  img/sec/chip throughput readout, the north-star metric.
-"""
+"""Back-compat shim — the tracing/profiling/timing utilities moved into the
+unified telemetry subsystem :mod:`p2p_tpu.obs` (spans, registry, sinks,
+watchdogs live there too). Import from ``p2p_tpu.obs`` in new code."""
 
 from __future__ import annotations
 
-import contextlib
-import time
-from typing import Optional
+from p2p_tpu.obs.spans import annotate, trace
+from p2p_tpu.obs.timing import StepTimer, measure_rtt
 
-import jax
-
-
-@contextlib.contextmanager
-def trace(logdir: str):
-    """Capture a device+host profile for the enclosed block."""
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-def annotate(name: str):
-    """Named region visible in the trace timeline."""
-    return jax.profiler.TraceAnnotation(name)
-
-
-class StepTimer:
-    """Wall-clock over fenced steps.
-
-    >>> t = StepTimer(batch_size=64)
-    >>> for batch in data:
-    ...     state, m = step(state, batch)
-    ...     t.tick(m)           # fences on the metrics pytree
-    >>> t.images_per_sec
-    """
-
-    def __init__(self, batch_size: int, skip_first: int = 1):
-        self.batch_size = batch_size
-        self.skip_first = skip_first       # warmup intervals to discard
-        self.intervals = 0                 # timed step intervals
-        self.elapsed = 0.0
-        self._seen = 0
-        self._t0: Optional[float] = None
-
-    def tick(self, fence_on=None) -> None:
-        if fence_on is not None:
-            jax.block_until_ready(fence_on)
-        now = time.perf_counter()
-        if self._t0 is not None:
-            self._seen += 1
-            if self._seen > self.skip_first:
-                self.elapsed += now - self._t0
-                self.intervals += 1
-        self._t0 = now
-
-    @property
-    def images_per_sec(self) -> float:
-        if self.elapsed <= 0 or self.intervals <= 0:
-            return 0.0
-        return self.batch_size * self.intervals / self.elapsed
+__all__ = ["StepTimer", "annotate", "measure_rtt", "trace"]

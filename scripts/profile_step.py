@@ -44,7 +44,7 @@ def capture(args) -> str:
     from p2p_tpu.models.vgg import load_vgg19_params
     from p2p_tpu.train.state import create_train_state
     from p2p_tpu.train.step import build_multi_train_step
-    from p2p_tpu.utils.profiling import trace
+    from p2p_tpu.obs import span, trace
 
     cfg = get_preset(args.preset)
     h = args.img or cfg.data.image_size
@@ -93,9 +93,12 @@ def capture(args) -> str:
         step = build_multi_train_step(cfg, vgg, train_dtype=dtype)
     batches = {k: jnp.asarray(np.broadcast_to(v, (args.steps,) + v.shape)
                               .copy(), jnp.float32) for k, v in host.items()}
-    state, m = step(state, batches)          # compile
-    float(m["loss_g"][-1])
-    with trace(args.logdir):
+    with span("profile_compile"):
+        state, m = step(state, batches)      # compile
+        float(m["loss_g"][-1])
+    with trace(args.logdir), span("profile_capture"):
+        # the span's TraceAnnotation names the captured region on the
+        # device timeline alongside XLA's own markers
         state, m = step(state, batches)
         float(m["loss_g"][-1])               # fence via host fetch
     traces = sorted(glob.glob(os.path.join(

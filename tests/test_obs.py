@@ -1,0 +1,469 @@
+"""The telemetry subsystem (p2p_tpu.obs): registry aggregation math, JSONL
+crash-safety, span nesting + Perfetto export, in-jit NaN sentinels on CPU,
+retrace-watchdog compile counting, check_finite event emission, chained
+StepTimer math, manifest provenance, and the Trainer wiring."""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_tpu import obs
+from p2p_tpu.obs.registry import combine_host_snapshots
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_metric_factories_are_idempotent():
+    r = obs.MetricsRegistry()
+    c1 = r.counter("images", split="train")
+    c1.inc(5)
+    r.counter("images", split="train").inc(3)
+    assert r.counter("images", split="train").value == 8
+    # different tags → different metric
+    assert r.counter("images", split="eval").value == 0
+
+
+def test_histogram_math():
+    r = obs.MetricsRegistry()
+    h = r.histogram("lat")
+    for v in (0.001, 0.002, 0.004, 1.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(1.007)
+    assert h.min == pytest.approx(0.001)
+    assert h.max == pytest.approx(1.0)
+    assert h.mean == pytest.approx(1.007 / 4)
+    # p50 of {1,2,4,1000} ms sits in the couple-of-ms buckets, far from max
+    assert h.quantile(0.5) < 0.02
+
+
+def test_ewma_rate_tracks_event_rate():
+    t = [0.0]
+    e = obs.registry.EWMARate("r", halflife_s=1.0, clock=lambda: t[0])
+    e.mark(10)            # first mark only sets the epoch
+    for _ in range(50):   # 10 events per 0.1 s → 100/s
+        t[0] += 0.1
+        e.mark(10)
+    assert e.rate == pytest.approx(100.0, rel=0.05)
+
+
+def test_cross_host_combine_math():
+    kinds = {"n": "counter", "g": "gauge", "h": "histogram", "e": "ewma"}
+    rows = [
+        {"n": {"value": 3}, "g": {"value": 1.0},
+         "h": {"count": 2, "sum": 4.0, "min": 1.0, "max": 3.0},
+         "e": {"rate": 50.0}},
+        {"n": {"value": 4}, "g": {"value": 3.0},
+         "h": {"count": 1, "sum": 9.0, "min": 9.0, "max": 9.0},
+         "e": {"rate": 70.0}},
+    ]
+    out = combine_host_snapshots(rows, kinds)
+    assert out["n"]["value"] == 7                      # counters sum
+    assert out["g"]["value_mean"] == pytest.approx(2.0)  # gauges mean+max
+    assert out["g"]["value_max"] == pytest.approx(3.0)
+    assert out["h"] == {"count": 3, "sum": 13.0, "min": 1.0, "max": 9.0,
+                        "mean": pytest.approx(13.0 / 3)}
+    assert out["e"]["rate"] == pytest.approx(120.0)    # rates add
+    # a metric present on one host only still combines
+    out2 = combine_host_snapshots(
+        [{"n": {"value": 1}}, {}], {"n": "counter"})
+    assert out2["n"]["value"] == 1
+
+
+def test_aggregate_single_process_matches_combine_fields():
+    r = obs.MetricsRegistry()
+    r.counter("c").inc(2)
+    r.gauge("g").set(5.0)
+    agg = r.aggregate()
+    assert agg["c"]["value"] == 2
+    assert agg["g"]["value_mean"] == 5.0 and agg["g"]["value_max"] == 5.0
+
+
+# ------------------------------------------------------------------- sinks
+def test_jsonl_sink_round_trip_and_force_flush(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    r = obs.MetricsRegistry()
+    sink = obs.JSONLSink(path, flush_every=1000)   # large buffer on purpose
+    r.add_sink(sink)
+    r.record({"kind": "train", "step": 1, "loss": np.float32(0.5)})
+    r.record({"kind": "epoch", "epoch": 1, "lr": 2e-4}, force=True)
+    # crash-safety: WITHOUT close(), the force=True record (and everything
+    # before it) must already be on disk — a SIGKILLed run keeps them
+    lines = [json.loads(x) for x in open(path)]
+    assert [x["kind"] for x in lines] == ["train", "epoch"]
+    assert lines[0]["loss"] == 0.5                 # device scalar coerced
+    assert lines[1]["lr"] == pytest.approx(2e-4)
+    # buffered (non-force) records appear after close; close is idempotent
+    r.record({"kind": "train", "step": 2})
+    sink.close()
+    sink.close()
+    assert len(open(path).readlines()) == 3
+    sink.write({"kind": "late"}, force=True)       # post-close write: no-op
+    assert len(open(path).readlines()) == 3
+
+
+def test_metrics_logger_facade_matches_seed_api(tmp_path, capsys):
+    path = str(tmp_path / "m.jsonl")
+    lg = obs.MetricsLogger(path, print_every=50)
+    lg.log({"kind": "train", "step": 50, "loss_g": 1.25})
+    lg.log({"kind": "train", "step": 51, "loss_g": 1.0})
+    out = capsys.readouterr().out
+    assert "loss_g=1.2500" in out          # heartbeat at step%50==0
+    assert "loss_g=1.0000" not in out      # silent off-heartbeat
+    recs = [json.loads(x) for x in open(path)]
+    assert [r["step"] for r in recs] == [50, 51]   # JSONL carries every record
+
+
+def test_prometheus_textfile_export(tmp_path):
+    r = obs.MetricsRegistry()
+    r.counter("images_total").inc(7)
+    r.gauge("hbm_bytes", device=0).set(123.0)
+    path = str(tmp_path / "p2p.prom")
+    sink = obs.PrometheusTextfileSink(path, r)
+    r.add_sink(sink)
+    r.record({"kind": "x"}, force=True)
+    text = open(path).read()
+    assert "# TYPE images_total counter" in text
+    assert "images_total 7.0" in text
+    # label values must be quoted — one bare value makes node_exporter's
+    # textfile collector reject the entire file
+    assert 'hbm_bytes{device="0"} 123.0' in text
+
+
+# ------------------------------------------------------------------- spans
+def test_span_nesting_and_perfetto_export(tmp_path):
+    rec = obs.SpanRecorder()
+    reg = obs.MetricsRegistry()
+    events = []
+    reg.add_sink(type("S", (obs.Sink,), {
+        "write": lambda self, r, force=False: events.append(r)})())
+    with rec.span("epoch", registry=reg, epoch=1):
+        with rec.span("dispatch"):
+            pass
+        with rec.span("dispatch"):
+            pass
+    # children finish first; depths recorded relative to the stack
+    names = [(s["name"], s["depth"]) for s in rec.spans]
+    assert names == [("dispatch", 1), ("dispatch", 1), ("epoch", 0)]
+    assert events and events[0]["kind"] == "span" and events[0]["epoch"] == 1
+    path = rec.export_perfetto(str(tmp_path / "t.json"))
+    doc = json.load(open(path))
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 3
+    epoch = next(e for e in xs if e["name"] == "epoch")
+    for d in (e for e in xs if e["name"] == "dispatch"):
+        # nesting falls out of interval containment
+        assert epoch["ts"] <= d["ts"]
+        assert d["ts"] + d["dur"] <= epoch["ts"] + epoch["dur"] + 1
+
+
+def test_span_ring_drops_oldest_and_timed_annotation_feeds_histogram():
+    rec = obs.SpanRecorder(max_spans=3)
+    for i in range(5):
+        with rec.span(f"s{i}"):
+            pass
+    # drop-OLDEST: the exported window is the run's most recent spans
+    assert [s["name"] for s in rec.spans] == ["s2", "s3", "s4"]
+    assert rec.dropped == 2
+    h = obs.MetricsRegistry().histogram("d")
+    with obs.timed_annotation("hot", h):
+        pass
+    assert h.count == 1 and h.sum >= 0
+
+
+# ----------------------------------------------------------------- sentinel
+def test_nan_sentinel_fires_in_jit_on_cpu():
+    fired = []
+    handler = fired.append
+    obs.add_sentinel_handler(handler)
+    try:
+        @jax.jit
+        def step(x):
+            m = {"loss_g": jnp.sum(x), "loss_d": jnp.ones(())}
+            obs.nan_sentinel(m, tag="train_step")
+            return m
+
+        step(jnp.ones((4,)))
+        jax.effects_barrier()
+        assert fired == []                       # happy path: silent
+        step(jnp.asarray([1.0, np.nan, np.inf, np.inf]))
+        jax.effects_barrier()
+        assert len(fired) == 1
+        ev = fired[0]
+        assert ev["kind"] == "sentinel" and ev["tag"] == "train_step"
+        assert ev["leaves"]["loss_g"] == {"nan": 1, "inf": 0}
+        # the process-default registry counted the event
+        assert obs.get_registry().counter(
+            "nonfinite_events", tag="train_step").value >= 1
+    finally:
+        obs.remove_sentinel_handler(handler)
+
+
+def test_nan_sentinel_under_scan():
+    fired = []
+    obs.add_sentinel_handler(fired.append)
+    try:
+        @jax.jit
+        def multi(xs):
+            def body(c, x):
+                obs.nan_sentinel({"v": jnp.sum(x)}, tag="scan")
+                return c, jnp.sum(x)
+
+            return jax.lax.scan(body, 0.0, xs)
+
+        xs = np.ones((3, 2), np.float32)
+        xs[1, 0] = np.nan
+        multi(jnp.asarray(xs))
+        jax.effects_barrier()
+        assert len(fired) == 1 and fired[0]["tag"] == "scan"
+    finally:
+        # bound-method equality makes this remove the handler added above
+        obs.remove_sentinel_handler(fired.append)
+
+
+def test_grad_norm_taps():
+    m = obs.grad_norm_taps({}, g={"w": jnp.asarray([3.0, 4.0])}, d=None)
+    assert float(m["grad_norm_g"]) == pytest.approx(5.0)
+    assert "grad_norm_d" not in m
+
+
+# -------------------------------------------------------------- check_finite
+def test_check_finite_names_the_leaf_and_emits_event():
+    reg = obs.MetricsRegistry()
+    events = []
+    reg.add_sink(type("S", (obs.Sink,), {
+        "write": lambda self, r, force=False: events.append((r, force))})())
+    from p2p_tpu.core.debug import check_finite
+
+    good = {"a": jnp.ones((2,))}
+    assert check_finite(good, registry=reg) == []
+    bad = {"a": jnp.ones((2,)), "b": {"c": jnp.asarray([1.0, np.nan, np.inf])}}
+    with pytest.raises(FloatingPointError, match="b/c"):
+        check_finite(bad, "state", registry=reg)
+    assert len(events) == 1
+    rec, force = events[0]
+    assert force and rec["kind"] == "nonfinite" and rec["name"] == "state"
+    assert rec["leaves"] == [{"leaf": "b/c", "nan": 1, "inf": 1}]
+    # degrade mode: report, don't raise
+    assert check_finite(bad, raise_=False)[0]["leaf"] == "b/c"
+
+
+# ------------------------------------------------------------------ watchdogs
+def test_retrace_watchdog_counts_forced_recompile():
+    reg = obs.MetricsRegistry()
+    w = obs.RetraceWatchdog(registry=reg)
+    try:
+        f = jax.jit(lambda x: x * 3 + 1)
+        f(jnp.ones((2,)))                    # warmup compile
+        warm = w.compiles
+        w.arm()
+        f(jnp.ones((2,)))                    # cache hit: no compile
+        assert w.compiles == warm and w.unexpected == 0
+        f(jnp.ones((5,)))                    # shape wobble → recompile
+        assert w.unexpected >= 1
+        assert reg.counter("unexpected_recompiles").value >= 1
+        assert reg.histogram("xla_compile_secs").count >= 1
+    finally:
+        w.close()
+
+
+def test_memory_watchdog_cpu_is_quiet():
+    # CPU devices expose no memory_stats — sample() must return {} and
+    # write nothing rather than raise
+    w = obs.MemoryWatchdog(registry=obs.MetricsRegistry())
+    assert w.sample() == {}
+
+
+# -------------------------------------------------------------------- timing
+def test_step_timer_chain_math(monkeypatch):
+    from p2p_tpu.obs import timing
+
+    t = [0.0]
+    monkeypatch.setattr(timing.time, "perf_counter", lambda: t[0])
+    timer = obs.StepTimer(batch_size=10)
+    with timer.chain(steps=8, rtt=1.0) as ch:
+        t[0] += 5.0                          # 8 steps in 5s incl. 1s RTT
+        ch.fence(jnp.ones(()))
+    assert timer.intervals == 8
+    assert timer.elapsed == pytest.approx(4.0)
+    assert timer.images_per_sec == pytest.approx(10 * 8 / 4.0)
+    # loop-style ticks feed the same accumulator
+    timer2 = obs.StepTimer(batch_size=10, skip_first=1)
+    for _ in range(4):
+        timer2.tick()
+        t[0] += 1.0
+    timer2.tick()
+    assert timer2.intervals == 3
+    assert timer2.images_per_sec == pytest.approx(10.0)
+
+
+# ------------------------------------------------------------------ manifest
+def test_manifest_hash_and_write(tmp_path):
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+
+    cfg = get_preset("facades")
+    assert obs.config_hash(cfg) == obs.config_hash(get_preset("facades"))
+    cfg2 = cfg.replace(data=dataclasses.replace(cfg.data, batch_size=7))
+    assert obs.config_hash(cfg) != obs.config_hash(cfg2)
+    path = str(tmp_path / "manifest.json")
+    man = obs.write_manifest(path, cfg)
+    on_disk = json.load(open(path))
+    assert on_disk["config_hash"] == man["config_hash"]
+    assert on_disk["dtype_policy"]["compute"] == "bfloat16"
+    assert on_disk["config"]["data"]["batch_size"] == 1
+    assert on_disk["jax_version"] == jax.__version__
+
+
+# ------------------------------------------------------- trainer integration
+def test_trainer_obs_wiring(tmp_path, monkeypatch):
+    """The migrated Trainer produces, through obs: a manifest file, a
+    provenance + epoch record in the metrics JSONL, and a Perfetto span
+    trace at fit() end — with fake step fns, so no step compile cost."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=4, n_test=2, size=16)
+    cfg = get_preset("facades")
+    cfg = cfg.replace(
+        name="obswire",
+        model=dataclasses.replace(cfg.model, ngf=4, ndf=4),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=16,
+                                 threads=0),
+        train=dataclasses.replace(cfg.train, mixed_precision=False,
+                                  nepoch=1, epoch_save=1, log_every=1,
+                                  eval_every_epoch=False),
+    )
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path))
+    try:
+        assert tr.logger.registry is tr.obs
+
+        def train_step(state, batch):
+            return state.replace(step=state.step + 1), {
+                "loss_g": jnp.float32(1.0), "loss_d": jnp.float32(2.0)}
+
+        tr.train_step = train_step
+        tr.multi_step = None
+        tr.fit()
+
+        manifest = json.load(open(tmp_path / "manifest_obswire.json"))
+        assert manifest["config_hash"] == obs.config_hash(cfg)
+        assert manifest["mesh_shape"] == {"data": 1, "spatial": 1, "time": 1,
+                                          "model": 1, "pipe": 1}
+
+        recs = [json.loads(x) for x in open(tmp_path / "metrics_obswire.jsonl")]
+        kinds = [r["kind"] for r in recs]
+        assert kinds[0] == "manifest"
+        assert "train" in kinds and "epoch" in kinds
+        epoch = next(r for r in recs if r["kind"] == "epoch")
+        assert epoch["epoch"] == 1 and math.isfinite(epoch["loss_g"])
+
+        trace_doc = json.load(open(tmp_path / "trace_obswire.json"))
+        names = {e["name"] for e in trace_doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert {"epoch", "train_dispatch", "checkpoint_save"} <= names
+        # the dispatch-rate EWMA saw the epoch's dispatches (2 marks: the
+        # first pins the clock epoch, the second produces a rate), and
+        # every dispatch fed the duration histogram
+        assert tr.obs.ewma("img_dispatch_rate").rate > 0
+        assert tr.obs.histogram("dispatch_secs").count == 2
+        assert tr.retrace.armed
+    finally:
+        tr.close()
+    # close() unhooked the process-global compile listener (a later
+    # trainer in this process must not pollute this run's stream)
+    from jax._src import monitoring as _mon
+
+    assert tr.retrace._on_event not in _mon.get_event_duration_listeners()
+    tr.close()  # idempotent
+
+
+def test_trainer_check_finite_flag_emits_and_raises(tmp_path):
+    import dataclasses
+
+    from p2p_tpu.core.config import DebugConfig, get_preset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=4, n_test=2, size=16)
+    cfg = get_preset("facades")
+    cfg = cfg.replace(
+        name="cf",
+        model=dataclasses.replace(cfg.model, ngf=4, ndf=4),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=16,
+                                 threads=0),
+        train=dataclasses.replace(cfg.train, mixed_precision=False,
+                                  log_every=1000, scan_steps=2),
+        debug=DebugConfig(check_finite=True),
+    )
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path))
+    try:
+        def nan_multi_step(state, batches):
+            k = next(iter(batches.values())).shape[0]
+            # NaN in an INTERMEDIATE scanned step, finite in the last —
+            # the guard checks the scan-axis sum, so it must still fire
+            v = np.ones((k,), np.float32)
+            v[0] = np.nan
+            return state.replace(step=state.step + k), {
+                "loss_g": jnp.asarray(v)}
+
+        tr.train_step = lambda s, b: (s.replace(step=s.step + 1),
+                                      {"loss_g": jnp.float32(np.nan)})
+        tr.multi_step = nan_multi_step
+        with pytest.raises(FloatingPointError, match="loss_g"):
+            tr.train_epoch()
+        recs = [json.loads(x) for x in open(tmp_path / "metrics_cf.jsonl")]
+        bad = [r for r in recs if r["kind"] == "nonfinite"]
+        # the evidence reached the (force-flushed) stream BEFORE the raise
+        assert bad and bad[0]["leaves"][0]["leaf"] == "loss_g"
+    finally:
+        tr.close()
+
+
+def test_trainer_sentinel_handler_routes_to_run_registry(tmp_path):
+    """cfg.debug.nan_sentinel: sentinel events land in THIS run's metrics
+    stream and tick nonfinite_events on the trainer's registry (the one
+    exporters snapshot), and close() unregisters the handler."""
+    import dataclasses
+
+    from p2p_tpu.core.config import DebugConfig, get_preset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.obs import taps
+    from p2p_tpu.train.loop import Trainer
+
+    root = str(tmp_path / "ds")
+    make_synthetic_dataset(root, n_train=4, n_test=2, size=16)
+    cfg = get_preset("facades")
+    cfg = cfg.replace(
+        name="sent",
+        model=dataclasses.replace(cfg.model, ngf=4, ndf=4),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=16,
+                                 threads=0),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+        debug=DebugConfig(nan_sentinel=True),
+    )
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path))
+    try:
+        assert tr._sentinel_handler in taps._handlers
+        tr._sentinel_handler(
+            {"kind": "sentinel", "tag": "train_step", "nan": 1, "inf": 0})
+        assert tr.obs.counter(
+            "nonfinite_events", tag="train_step").value == 1
+        recs = [json.loads(x)
+                for x in open(tmp_path / "metrics_sent.jsonl")]
+        assert any(r["kind"] == "sentinel" for r in recs)
+    finally:
+        tr.close()
+    assert tr._sentinel_handler is None
+    assert all(getattr(h, "__name__", "") != "_handler"
+               for h in taps._handlers)
