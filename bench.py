@@ -23,6 +23,14 @@ Prints ONE JSON line:
 Env knobs: BENCH_PRESET, BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG;
 BENCH_JSONL=<path> additionally appends the record (kind="bench") to that
 metrics stream through the obs registry.
+
+``--sweep`` runs the six BASELINE.md contract rows (headline, bs=1,
+edges2shoes int8-delayed, cityscapes, pix2pixhd, vid2vid) and diffs each
+against the last-recorded band, exiting nonzero on a >3% regression below
+the band floor — the standing perf-regression gate (VERDICT r5 #7).
+``--sweep --dry-run`` shrinks every row to toy dims and skips the band
+check: a CPU-able plumbing test that each contract config still builds,
+steps, and reports (CI runs it).
 """
 
 from __future__ import annotations
@@ -30,9 +38,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 
 
-def main() -> None:
+def run_single(tiny: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -57,7 +66,12 @@ def main() -> None:
     facades_like = preset in ("facades", "facades_int8")
     # BENCH_IMG overrides to a square size; otherwise non-default presets
     # bench at their NATIVE dims (e.g. pix2pixhd 1024×512), facades at 256².
-    if "BENCH_IMG" in os.environ or facades_like or not on_tpu:
+    if tiny:
+        # --sweep --dry-run: toy dims proving the config builds and steps
+        # (keep a rectangular extent when the preset has one — the HD
+        # generators assume W > H)
+        img, wid = 32, (64 if cfg.data.image_width else None)
+    elif "BENCH_IMG" in os.environ or facades_like or not on_tpu:
         img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
         wid = None
     else:
@@ -68,6 +82,16 @@ def main() -> None:
     scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "2"))
     n_calls = int(os.environ.get("BENCH_STEPS", "64" if on_tpu else "4")) // scan_k
     n_calls = max(n_calls, 2)
+    if tiny:
+        bs, scan_k, n_calls = 1, 2, 2
+        cfg = cfg.replace(
+            model=dataclasses.replace(
+                cfg.model, ngf=8, ndf=8, num_D=min(cfg.model.num_D, 2),
+                n_layers_D=2, n_blocks=min(cfg.model.n_blocks, 2)),
+            data=dataclasses.replace(
+                cfg.data, n_frames=min(cfg.data.n_frames, 2)),
+            loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+        )
 
     cfg = cfg.replace(
         data=dataclasses.replace(
@@ -232,8 +256,111 @@ def main() -> None:
         reg.record({"kind": "bench", "rtt_sec": round(rtt, 6), **record},
                    force=True)
         sink.close()
-    print(json.dumps(record))
+    return record
+
+
+# ---------------------------------------------------------------------------
+# --sweep: the standing perf-regression gate (VERDICT r5 #7)
+# ---------------------------------------------------------------------------
+
+# The six contract rows with BASELINE.md's last-recorded bands
+# (img/s/chip; round-5 ledger + session-2 final-tree regression sweep).
+# A row regresses when it lands >3% below its band FLOOR — the band width
+# itself is documented tunnel/day drift, not regression.
+SWEEP_ROWS = [
+    {"name": "headline_facades_int8_bs128", "env": {},
+     "band": (1684.4, 1717.2)},
+    {"name": "facades_int8_bs1", "env": {"BENCH_BS": "1"},
+     "band": (217.0, 228.7)},
+    {"name": "edges2shoes_int8_delayed",
+     "env": {"BENCH_PRESET": "edges2shoes_dp", "BENCH_INT8": "1",
+             "BENCH_DELAYED": "1"},
+     "band": (1364.7, 1371.6)},
+    {"name": "cityscapes_spatial",
+     "env": {"BENCH_PRESET": "cityscapes_spatial"}, "band": (37.5, 37.9)},
+    {"name": "pix2pixhd", "env": {"BENCH_PRESET": "pix2pixhd"},
+     "band": (8.77, 8.81)},
+    {"name": "vid2vid_temporal",
+     "env": {"BENCH_PRESET": "vid2vid_temporal"}, "band": (200.3, 203.5)},
+]
+
+REGRESSION_TOLERANCE = 0.03
+
+
+def run_sweep(dry_run: bool = False) -> int:
+    """Run every contract row; return a nonzero exit code naming each row
+    that lands >3% under its band floor. ``dry_run`` shrinks the rows to
+    toy dims (CPU-able) and checks plumbing only."""
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    check_bands = on_tpu and not dry_run
+    if not check_bands and not dry_run:
+        print("note: not on TPU — values are not comparable to the "
+              "BASELINE.md bands; band check skipped", file=sys.stderr)
+    # the sweep owns these knobs; a stray env override would silently
+    # bench a different contract than the bands record
+    owned = ("BENCH_PRESET", "BENCH_BS", "BENCH_INT8", "BENCH_DELAYED",
+             "BENCH_IMG")
+    saved = {k: os.environ.pop(k) for k in owned if k in os.environ}
+    if saved:
+        print(f"note: ignoring {sorted(saved)} for --sweep",
+              file=sys.stderr)
+    regressions = []
+    results = []
+    try:
+        for row in SWEEP_ROWS:
+            os.environ.update(row["env"])
+            try:
+                rec = run_single(tiny=dry_run)
+            finally:
+                for k in row["env"]:
+                    os.environ.pop(k, None)
+            lo, hi = row["band"]
+            status = "ok"
+            if not (rec["value"] > 0):
+                status = "failed"
+                regressions.append((row["name"], rec["value"], lo))
+            elif check_bands:
+                floor = lo * (1 - REGRESSION_TOLERANCE)
+                if rec["value"] < floor:
+                    status = f"REGRESSION (<{floor:.1f})"
+                    regressions.append((row["name"], rec["value"], lo))
+            results.append({"row": row["name"], "value": rec["value"],
+                            "band": [lo, hi], "status": status,
+                            "metric": rec["metric"]})
+            print(json.dumps(results[-1]), flush=True)
+    finally:
+        os.environ.update(saved)
+    print(json.dumps({
+        "kind": "bench_sweep", "dry_run": dry_run,
+        "bands_checked": check_bands, "rows": len(results),
+        "regressions": [r[0] for r in regressions],
+    }))
+    if regressions:
+        for name, val, lo in regressions:
+            print(f"REGRESSION: {name} = {val} vs band floor {lo} "
+                  f"(-{(1 - val / lo) * 100:.1f}%)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sweep", action="store_true",
+                    help="run all six BASELINE.md contract rows and fail "
+                         "on >3% regression below the recorded band")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --sweep: toy dims, plumbing check only "
+                         "(CPU-able; no band comparison)")
+    args = ap.parse_args(argv)
+    if args.sweep:
+        return run_sweep(dry_run=args.dry_run)
+    print(json.dumps(run_single()))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

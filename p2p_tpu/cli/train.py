@@ -30,7 +30,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoints/results/metrics land here")
     p.add_argument("--mesh", type=str, default=None,
                    help="mesh axes 'data,spatial,time[,model[,pipe]]' e.g. "
-                        "'4,2,1' (data may be -1 = all remaining devices)")
+                        "'4,2,1' (data may be -1 = all remaining devices); "
+                        "model>1 trains tensor-parallel (docs/PARALLELISM.md)")
+    p.add_argument("--tp_min_ch", type=int, default=None,
+                   help="smallest channel count the TP pair rule shards "
+                        "over the model axis (ParallelConfig.tp_min_ch; "
+                        "default 512 — lower it only for toy models)")
     p.add_argument("--image_width", type=int, default=None,
                    help="image width when not square (e.g. pix2pixhd "
                         "1024x512 trains height=512 width=1024)")
@@ -215,6 +220,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  log_every=args.log_every)
     debug = over(cfg.debug, check_finite=args.check_finite,
                  nan_sentinel=args.nan_sentinel, grad_norms=args.grad_norms)
+    par = over(par, tp_min_ch=args.tp_min_ch)
     if args.mesh is not None:
         from p2p_tpu.core.mesh import MeshSpec
 

@@ -205,6 +205,11 @@ class DataConfig:
 @dataclasses.dataclass(frozen=True)
 class ParallelConfig:
     mesh: MeshSpec = MeshSpec(data=-1, spatial=1, time=1)
+    # Tensor parallelism (mesh.model > 1): smallest channel count the
+    # Megatron pair rule shards (parallel/tp.py tp_sharding_tree). 512
+    # keeps the narrow layers replicated where a psum would cost more
+    # than the shard saves; tests/dryruns lower it so tiny models shard.
+    tp_min_ch: int = 512
     # Sync batch-norm statistics across the data axis (pmean). At bs=1 per
     # device this is the only way BatchNorm matches reference semantics.
     sync_batchnorm: bool = True

@@ -76,7 +76,7 @@ class ExpandNetwork(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, trunk_fn=None):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
         # EVERY conv here (head included, networks.py:471-475 BN after the
         # k9 head) is norm-followed → all conv biases are dead
@@ -93,13 +93,19 @@ class ExpandNetwork(nn.Module):
         y = act(mk()(ConvLayer(self.ngf * 4, kernel_size=3, stride=2,
                                use_bias=ub, dtype=self.dtype)(y)))
 
-        block_cls = remat_wrap(ResidualBlock, self.remat)
         residual = y
-        for i in range(self.n_blocks):
-            # explicit name: remat wrapping must not change param paths
-            y = block_cls(self.ngf * 4, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
-                          legacy_layout=self.legacy_layout, dtype=self.dtype,
-                          name=f"ResidualBlock_{i}")(y, train)
+        if trunk_fn is not None:
+            # externally-scheduled trunk (the GPipe path, parallel/pp.py):
+            # the block submodules are never created, so their variables
+            # live outside this module — in the pipe-sharded stage stack
+            y = trunk_fn(y)
+        else:
+            block_cls = remat_wrap(ResidualBlock, self.remat)
+            for i in range(self.n_blocks):
+                # explicit name: remat wrapping must not change param paths
+                y = block_cls(self.ngf * 4, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
+                              legacy_layout=self.legacy_layout, dtype=self.dtype,
+                              name=f"ResidualBlock_{i}")(y, train)
         y = leaky_relu_y(y + residual, 0.2)
 
         y = act(mk()(UpsampleConvLayer(self.ngf * 2, kernel_size=3,

@@ -77,7 +77,7 @@ class ResnetGenerator(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, trunk_fn=None):
         mk = make_norm(self.norm, train=train, dtype=self.dtype)
         cap = self.max_features or (1 << 30)
         # every conv below except the head is norm-followed → dead bias
@@ -92,15 +92,21 @@ class ResnetGenerator(nn.Module):
                           dtype=self.dtype)(y)
             y = relu_y(mk()(y))
 
-        block_cls = remat_wrap(ResnetBlock, self.remat)
-        f_trunk = min(self.ngf * (2 ** self.n_downsampling), cap)
-        for i in range(self.n_blocks):
-            # explicit name: remat wrapping must not change param paths
-            # (nn.remat's auto-name is 'CheckpointResnetBlock_i', which
-            # would silently re-key checkpoints when remat is toggled)
-            y = block_cls(f_trunk, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
-                          legacy_layout=self.legacy_layout, dtype=self.dtype,
-                          name=f"ResnetBlock_{i}")(y, train)
+        if trunk_fn is not None:
+            # externally-scheduled trunk (the GPipe path, parallel/pp.py):
+            # block submodules never instantiate — their variables live in
+            # the pipe-sharded stage stack, not this module's tree
+            y = trunk_fn(y)
+        else:
+            block_cls = remat_wrap(ResnetBlock, self.remat)
+            f_trunk = min(self.ngf * (2 ** self.n_downsampling), cap)
+            for i in range(self.n_blocks):
+                # explicit name: remat wrapping must not change param paths
+                # (nn.remat's auto-name is 'CheckpointResnetBlock_i', which
+                # would silently re-key checkpoints when remat is toggled)
+                y = block_cls(f_trunk, norm=self.norm, int8=self.int8, int8_delayed=self.int8_delayed,
+                              legacy_layout=self.legacy_layout, dtype=self.dtype,
+                              name=f"ResnetBlock_{i}")(y, train)
 
         for i in reversed(range(self.n_downsampling)):
             f = min(self.ngf * (2 ** i), cap)

@@ -318,6 +318,20 @@ int8_conv_ds.defvjp(_int8_conv_ds_fwd, _int8_conv_ds_bwd)
 AMAX_DECAY = 0.95
 
 
+def amax_update(cur_amax: jax.Array, stored: jax.Array) -> jax.Array:
+    """The delayed-scale update law: max(cur, AMAX_DECAY·stored).
+
+    Shared contract between the per-layer ``_delayed_scale`` plumbing below
+    and the GPipe quant stacking (parallel/pp.py): because the pipelined
+    forward quantizes every microbatch with the FROZEN start-of-step scale,
+    the per-microbatch update *proposals* can be max-combined —
+    max_m(max(amax_m, d·s)) == max(max_m(amax_m), d·s) == this law on the
+    full-batch amax — so the stacked-quant pipeline reproduces the
+    unpipelined update bitwise.
+    """
+    return jnp.maximum(cur_amax, AMAX_DECAY * stored)
+
+
 def _norm_pair(v) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
 
@@ -335,7 +349,7 @@ def _delayed_scale(mod: nn.Module, x: jax.Array):
 
     def update(cur_amax):
         if mod.is_mutable_collection("quant"):
-            amax_v.value = jnp.maximum(cur_amax, AMAX_DECAY * amax_v.value)
+            amax_v.value = amax_update(cur_amax, amax_v.value)
 
     return sx, update
 

@@ -49,10 +49,13 @@ def sharded_pallas_instance_norm(
     Here each device runs the kernel on its local H-shard and only the
     (N,1,1,C) stat tiles cross the ICI via psum.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from p2p_tpu.core.mesh import DATA_AXIS, SPATIAL_AXIS
+    from p2p_tpu.core.mesh import (
+        DATA_AXIS,
+        SPATIAL_AXIS,
+        shard_map_compat as shard_map,
+    )
     from p2p_tpu.ops.pallas.instance_norm_kernel import (
         instance_norm_fused_sharded,
     )

@@ -31,6 +31,8 @@ from p2p_tpu.parallel.pp import (
     make_resnet_block_apply,
     place_trunk_pp,
     pp_expand_forward,
+    pp_generator_forward,
+    pp_split_state,
     stack_trunk,
 )
 from p2p_tpu.parallel.tp import place_state_tp, tp_sharding_tree
@@ -59,6 +61,8 @@ __all__ = [
     "make_resnet_block_apply",
     "place_trunk_pp",
     "pp_expand_forward",
+    "pp_generator_forward",
+    "pp_split_state",
     "stack_trunk",
     "place_state_tp",
     "tp_sharding_tree",

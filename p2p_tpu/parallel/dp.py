@@ -92,6 +92,44 @@ def make_parallel_train_step(
     )
 
 
+def make_parallel_multi_train_step(
+    cfg: Config,
+    mesh: Mesh,
+    vgg_params: Optional[Any] = None,
+    steps_per_epoch: int = 1,
+    train_dtype=None,
+    state_sharding: Optional[Any] = None,
+    unroll: int = 1,
+):
+    """``build_multi_train_step`` (K steps per dispatch via lax.scan) jitted
+    over ``mesh`` with explicit state/batch shardings — the scan-path twin
+    of :func:`make_parallel_train_step`, used by the CLI trainer when
+    ``scan_steps > 1`` on a TP mesh. Batches carry a leading K axis:
+    ``P(None, 'data', 'spatial', None, None)``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from p2p_tpu.core.mesh import DATA_AXIS, SPATIAL_AXIS
+
+    inner = build_train_step(
+        cfg, vgg_params, steps_per_epoch, train_dtype, jit=False
+    )
+
+    def multi_step(state, batches):
+        with mesh_context(mesh):
+            return jax.lax.scan(inner, state, batches, unroll=unroll)
+
+    rep = replicated(mesh)
+    stacked_bsh = NamedSharding(
+        mesh, P(None, DATA_AXIS, SPATIAL_AXIS, None, None))
+    ssh = rep if state_sharding is None else state_sharding
+    return jax.jit(
+        multi_step,
+        in_shardings=(ssh, stacked_bsh),
+        out_shardings=(ssh, rep),
+        donate_argnums=0,
+    )
+
+
 def make_parallel_eval_step(cfg: Config, mesh: Mesh, train_dtype=None):
     from p2p_tpu.train.step import build_eval_step
 

@@ -44,12 +44,18 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from p2p_tpu.core.mesh import DATA_AXIS, PIPE_AXIS
+from p2p_tpu.core.mesh import (
+    DATA_AXIS,
+    PIPE_AXIS,
+    pcast_varying,
+    shard_map_compat as shard_map,
+)
 
-BlockApply = Callable[[Dict[str, Any], jax.Array], jax.Array]
+# (block_vars, y) -> y — or -> (y, quant_proposal) for the delayed-int8
+# trunk (gpipe_trunk dispatches on the stacked 'quant' collection)
+BlockApply = Callable[[Dict[str, Any], jax.Array], Any]
 
 
 def stack_trunk(variables: Dict[str, Any], n_stages: int,
@@ -78,9 +84,13 @@ def stack_trunk(variables: Dict[str, Any], n_stages: int,
             lambda a: a.reshape((n_stages, per) + a.shape[1:]), flat)
 
     stacked = {"params": gather(variables["params"])}
-    stats = variables.get("batch_stats", {})
-    if names[0] in stats:
-        stacked["batch_stats"] = gather(stats)
+    # stage-regular non-param collections ride along: BN running stats and
+    # the delayed-int8 'quant' amax scales (both per-block, both [S, B]-
+    # stackable — the quant GPipe semantics live in gpipe_trunk)
+    for coll in ("batch_stats", "quant"):
+        entries = variables.get(coll) or {}
+        if names[0] in entries:
+            stacked[coll] = gather(entries)
     return stacked
 
 
@@ -92,83 +102,135 @@ def place_trunk_pp(stacked: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
 
 
 def gpipe_trunk(block_apply: BlockApply, stacked: Dict[str, Any],
-                y_mb: jax.Array, mesh: Mesh) -> jax.Array:
+                y_mb: jax.Array, mesh: Mesh):
     """Run the stacked trunk over ``y_mb`` [M, mb, H, W, C] with the GPipe
     fill/drain schedule on the mesh's ``pipe`` axis.
 
     ``block_apply(block_vars, y) -> y`` applies ONE residual block given its
     (unstacked) variable subtree. Output has the same shape/sharding as
     ``y_mb`` (mb stays on ``data``); result is replicated over ``pipe``.
+
+    When ``stacked`` carries a ``'quant'`` collection (the delayed-int8
+    trunk, ops/int8.py), ``block_apply`` must instead return ``(y, quant
+    proposal)`` — the block applied with the FROZEN stored scales plus the
+    mutated collection it proposes. Every microbatch then quantizes with
+    the same start-of-step scale (exactly the unpipelined batch semantics)
+    and the per-microbatch proposals are max-combined over the valid ticks
+    and psum-maxed over ``data``, which reproduces the unpipelined
+    full-batch ``amax_update`` bitwise (ops/int8.py). Returns ``(y_out,
+    new_quant_stack)`` in that case, ``y_out`` alone otherwise.
     """
     n_stages = mesh.shape[PIPE_AXIS]
     n_micro = int(y_mb.shape[0])
     ticks = n_micro + n_stages - 1
     act_spec = P(None, DATA_AXIS, *([None] * (y_mb.ndim - 2)))
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    has_quant = "quant" in stacked
 
     def shard_fn(st, xmb):
         local = jax.tree.map(lambda a: a[0], st)   # this stage's [B, ...]
         idx = jax.lax.axis_index(PIPE_AXIS)
 
         def stage(y):
+            if has_quant:
+                def body(c, bv):
+                    return block_apply(bv, c)      # (y', quant proposal)
+                return jax.lax.scan(body, y, local)
+
             def body(c, bv):
                 return block_apply(bv, c), None
             y, _ = jax.lax.scan(body, y, local)
-            return y
+            return y, {}
 
         def tick(carry, t):
-            act, out = carry
+            act, out, qacc = carry
             # stage 0 injects microbatch t (clamped re-feeds during drain
             # are bubble ticks whose output is never written)
             feed = jax.lax.dynamic_index_in_dim(
                 xmb, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
-            y_out = stage(jnp.where(idx == 0, feed, act))
+            y_out, qp = stage(jnp.where(idx == 0, feed, act))
+            if has_quant:
+                # amax bookkeeping is carried state, never a loss input —
+                # cut it out of the autodiff graph (pmax/psum-max below
+                # have no differentiation rule, and none is wanted)
+                qp = jax.tree.map(jax.lax.stop_gradient, qp)
+                # stage `idx` holds microbatch t-idx at tick t — bubble
+                # ticks (fill zeros, drain re-feeds) must not touch amax
+                valid = jnp.logical_and(t >= idx, t - idx <= n_micro - 1)
+                qacc = jax.tree.map(
+                    lambda a, p: jnp.where(valid, jnp.maximum(a, p), a),
+                    qacc, qp)
             # last stage retires microbatch t-(S-1) into its output slot
             o_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
             prev = jax.lax.dynamic_index_in_dim(out, o_idx, 0, keepdims=False)
             write = jnp.logical_and(t >= n_stages - 1, idx == n_stages - 1)
             out = jax.lax.dynamic_update_index_in_dim(
                 out, jnp.where(write, y_out, prev), o_idx, 0)
-            return (jax.lax.ppermute(y_out, PIPE_AXIS, perm), out), None
+            return (jax.lax.ppermute(y_out, PIPE_AXIS, perm), out, qacc), None
 
         # carries are stage-varying (idx enters tick) — pcast the replicated
         # zeros to the varying type shard_map's vma tracking expects
-        zero = jax.lax.pcast(
-            jnp.zeros(xmb.shape[1:], xmb.dtype), (DATA_AXIS, PIPE_AXIS),
-            to="varying")
-        out0 = jax.lax.pcast(jnp.zeros_like(xmb), (PIPE_AXIS,), to="varying")
-        (act, out), _ = jax.lax.scan(tick, (zero, out0), jnp.arange(ticks))
+        zero = pcast_varying(
+            jnp.zeros(xmb.shape[1:], xmb.dtype), (DATA_AXIS, PIPE_AXIS))
+        out0 = pcast_varying(jnp.zeros_like(xmb), (PIPE_AXIS,))
+        # amax proposals are >= 0, so max-accumulation starts from zeros
+        q0 = jax.tree.map(
+            lambda a: pcast_varying(jnp.zeros_like(a),
+                                    (DATA_AXIS, PIPE_AXIS)),
+            local.get("quant", {}))
+        (act, out, qacc), _ = jax.lax.scan(
+            tick, (zero, out0, q0), jnp.arange(ticks))
         # non-last stages accumulated zeros; the masked psum replicates the
         # last stage's outputs to every pipe shard
-        return jax.lax.psum(
+        y_full = jax.lax.psum(
             jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out)),
             PIPE_AXIS)
+        # each data shard saw only its rows — the global amax is the max
+        # over the data axis (exact: max of maxes), stage-local otherwise
+        q_new = jax.tree.map(
+            lambda a: jax.lax.pmax(a, DATA_AXIS)[None], qacc)
+        return y_full, q_new
 
-    return shard_map(
+    y_out, q_new = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(PIPE_AXIS), act_spec), out_specs=act_spec,
+        in_specs=(P(PIPE_AXIS), act_spec),
+        out_specs=(act_spec, P(PIPE_AXIS)),
     )(stacked, y_mb)
+    return (y_out, q_new) if has_quant else y_out
 
 
 # ---------------------------------------------------------------------------
-# Flagship wiring: pipelined ExpandNetwork forward
+# Generator wiring: pipelined trunk inside the REAL model module
 # ---------------------------------------------------------------------------
+
+
+def _quant_applier(block):
+    """Applier for a delayed-int8 block: frozen stored scales in the
+    forward, mutated 'quant' collection returned as the update proposal
+    (gpipe_trunk max-combines proposals — the semantics contract is
+    ops/int8.py amax_update)."""
+
+    def apply_mut(bvars, y):
+        out, mut = block.apply(bvars, y, False, mutable=["quant"])
+        return out, mut["quant"]
+
+    return apply_mut
 
 
 def make_expand_block_apply(model_cfg, dtype=None) -> BlockApply:
     """Block applier for ExpandNetwork's ``ResidualBlock_i`` trunk
-    (frozen-stat norms — see module docstring)."""
+    (frozen-stat norms — see module docstring). The int8 trunk (dynamic or
+    delayed scales) pipelines too: the delayed form returns ``(y, quant
+    proposal)`` pairs for gpipe_trunk's stacked-quant path."""
     from p2p_tpu.models.expand import ResidualBlock
 
-    if model_cfg.int8 and model_cfg.int8_generator:
-        # the int8-delayed trunk carries a 'quant' scale collection that
-        # stack_trunk does not stack (and that wants mutation per step)
-        raise NotImplementedError(
-            "pp v1 does not pipeline the int8 trunk; run int8 configs "
-            "unpipelined or stack the 'quant' collection first")
+    int8_g = model_cfg.int8 and model_cfg.int8_generator
     block = ResidualBlock(
-        model_cfg.ngf * 4, norm=model_cfg.norm,
+        model_cfg.ngf * 4, norm=model_cfg.norm, int8=int8_g,
+        int8_delayed=model_cfg.int8_delayed,
         legacy_layout=model_cfg.legacy_layout, dtype=dtype)
+    if int8_g and model_cfg.int8_delayed:
+        return _quant_applier(block)
 
     def apply_one(bvars, y):
         return block.apply(bvars, y, False)
@@ -177,7 +239,8 @@ def make_expand_block_apply(model_cfg, dtype=None) -> BlockApply:
 
 
 def make_resnet_block_apply(features: int, norm: str = "instance",
-                            legacy_layout: bool = False,
+                            legacy_layout: bool = False, int8: bool = False,
+                            int8_delayed: bool = False,
                             dtype=None) -> BlockApply:
     """Block applier for the ResNet family's ``ResnetBlock_i`` trunk
     (models/resnet_gen.py — cityscapes and pix2pixHD's ``global``/G1,
@@ -187,8 +250,11 @@ def make_resnet_block_apply(features: int, norm: str = "instance",
     is exact vs train mode (module docstring)."""
     from p2p_tpu.models.resnet_gen import ResnetBlock
 
-    block = ResnetBlock(features, norm=norm, legacy_layout=legacy_layout,
-                        dtype=dtype)
+    block = ResnetBlock(features, norm=norm, int8=int8,
+                        int8_delayed=int8_delayed,
+                        legacy_layout=legacy_layout, dtype=dtype)
+    if int8 and int8_delayed:
+        return _quant_applier(block)
 
     def apply_one(bvars, y):
         return block.apply(bvars, y, False)
@@ -196,96 +262,157 @@ def make_resnet_block_apply(features: int, norm: str = "instance",
     return apply_one
 
 
+def mb_major_flatten(t: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [mb*M, ...] with the data-sharded mb axis OUTERMOST,
+    so GSPMD keeps flat-batch (encoder/decoder) compute data-parallel — an
+    M-major flatten interleaves the shards and forces XLA to all-gather the
+    full batch onto every device. The ONE definition of the carve order
+    (its inverse below; pinned by the no-all-gather HLO test)."""
+    n_micro, mb = t.shape[0], t.shape[1]
+    return jnp.swapaxes(t, 0, 1).reshape((mb * n_micro,) + t.shape[2:])
+
+
+def mb_major_unflatten(t: jax.Array, n_micro: int) -> jax.Array:
+    """Inverse of :func:`mb_major_flatten`: [mb*M, ...] -> [M, mb, ...]."""
+    mb = t.shape[0] // n_micro
+    return jnp.swapaxes(t.reshape((mb, n_micro) + t.shape[1:]), 0, 1)
+
+
+_TRUNK_PREFIX = {"expand": "ResidualBlock_", "resnet": "ResnetBlock_"}
+
+
+def trunk_prefix(model_cfg) -> str:
+    try:
+        return _TRUNK_PREFIX[model_cfg.generator]
+    except KeyError:
+        raise NotImplementedError(
+            f"pp pipelines the expand/resnet trunk families, not "
+            f"{model_cfg.generator!r} (docs/PARALLELISM.md v2 boundaries)"
+        ) from None
+
+
+def _trunk_block_apply(model_cfg, dtype=None) -> BlockApply:
+    if model_cfg.generator == "expand":
+        return make_expand_block_apply(model_cfg, dtype)
+    # ResnetGenerator via define_G uses its default n_downsampling=2 and
+    # no feature cap → the trunk width is ngf * 4
+    int8_g = model_cfg.int8 and model_cfg.int8_generator
+    return make_resnet_block_apply(
+        model_cfg.ngf * 4, norm=model_cfg.norm,
+        legacy_layout=model_cfg.legacy_layout, int8=int8_g,
+        int8_delayed=model_cfg.int8_delayed, dtype=dtype)
+
+
+def pp_generator_forward(model_cfg, variables: Dict[str, Any],
+                         x_mb: jax.Array, mesh: Mesh,
+                         stacked: Optional[Dict[str, Any]] = None,
+                         dtype=None, with_quant: bool = False):
+    """Full pipelined generator forward (expand / resnet trunk families).
+
+    ``x_mb``: [M, mb, H, W, 3] microbatched input (mb sharded over ``data``).
+    Encoder/decoder run replicated over ``pipe`` on the mb-major flat batch
+    (they are <15% of the FLOPs — networks.py:460-520; pipelining them buys
+    nothing at this depth) through the REAL model module via its
+    ``trunk_fn`` hook — no hand-mirrored forward to drift — while the
+    residual trunk runs the GPipe schedule. The mb-major flatten keeps the
+    data-sharded mb axis outermost so GSPMD keeps the encoder/decoder
+    data-parallel (an M-major flatten interleaves the shards and forces
+    XLA to all-gather the full batch onto every device — pinned by the HLO
+    test in tests/test_pp.py).
+
+    ``with_quant=True`` additionally returns the updated stacked 'quant'
+    collection (None when the trunk carries none).
+    """
+    from p2p_tpu.models.registry import define_G
+
+    prefix = trunk_prefix(model_cfg)
+    if stacked is None:
+        stacked = stack_trunk(variables, mesh.shape[PIPE_AXIS],
+                              prefix=prefix)
+    block_apply = _trunk_block_apply(model_cfg, dtype)
+
+    n_micro = int(x_mb.shape[0])
+    q_new = None
+
+    def trunk_fn(y):
+        nonlocal q_new
+        r = gpipe_trunk(block_apply, stacked,
+                        mb_major_unflatten(y, n_micro), mesh)
+        if "quant" in stacked:
+            y_mb, q_new = r
+        else:
+            y_mb = r
+        return mb_major_flatten(y_mb)
+
+    g = define_G(model_cfg, dtype=dtype)
+    y = g.apply(
+        {"params": variables["params"],
+         "batch_stats": variables.get("batch_stats", {})},
+        mb_major_flatten(x_mb), False, trunk_fn=trunk_fn,
+    )
+    y = mb_major_unflatten(y, n_micro)
+    return (y, q_new) if with_quant else y
+
+
 def pp_expand_forward(model_cfg, variables: Dict[str, Any], x_mb: jax.Array,
                       mesh: Mesh,
                       stacked: Optional[Dict[str, Any]] = None,
                       dtype=None) -> jax.Array:
-    """Full pipelined flagship (ExpandNetwork) forward.
-
-    ``x_mb``: [M, mb, H, W, 3] microbatched input (mb sharded over ``data``).
-    Encoder/decoder run replicated over ``pipe`` on the flat batch (they are
-    <15% of the FLOPs — networks.py:460-520; pipelining them buys nothing at
-    this depth); the residual trunk runs the GPipe schedule. Mirrors
-    ExpandNetwork.__call__ (models/expand.py) name-for-name — drift between
-    the two is pinned bitwise by tests/test_pp.py.
-    """
+    """Pipelined flagship (ExpandNetwork) forward — the expand-only entry
+    point kept for compatibility; :func:`pp_generator_forward` is the
+    general form (and the one the PP train step uses)."""
     if model_cfg.generator != "expand":
         raise NotImplementedError(
-            "pp v1 pipelines the ExpandNetwork trunk; for the ResNet family "
-            "use gpipe_trunk() directly with a ResnetBlock applier")
+            "pp_expand_forward pipelines the ExpandNetwork trunk; use "
+            "pp_generator_forward for the ResNet family")
+    return pp_generator_forward(model_cfg, variables, x_mb, mesh,
+                                stacked=stacked, dtype=dtype)
 
-    from p2p_tpu.models.expand import ResidualBlock  # noqa: F401  (doc link)
-    from p2p_tpu.ops.activations import PReLU, leaky_relu_y, tanh_y
-    from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, upsample_nearest
-    from p2p_tpu.ops.norm import make_norm
-    from p2p_tpu.ops.pixel_shuffle import pixel_unshuffle
 
-    p = variables["params"]
-    bs = variables.get("batch_stats", {})
-    cfg = model_cfg
-    ub = cfg.legacy_layout or cfg.norm == "none"
-    mk = make_norm(cfg.norm, train=False, dtype=dtype)
+# ---------------------------------------------------------------------------
+# Trainer wiring: TrainState surgery for the PP step (train/step.py
+# build_pp_train_step)
+# ---------------------------------------------------------------------------
 
-    def norm_at(i, y):
-        if cfg.norm == "none":
-            return y
-        name = f"{type(mk()).__name__}_{i}"
-        vs = {}
-        if name in p:
-            vs["params"] = p[name]
-        if name in bs:
-            vs["batch_stats"] = bs[name]
-        return mk().apply(vs, y)
 
-    def act(y):
-        return PReLU().apply({"params": p["PReLU_0"]}, y)
+def pp_split_state(state, cfg, mesh: Mesh, steps_per_epoch: int = 1):
+    """Move the generator trunk out of a fresh TrainState into the
+    pipe-sharded ``pp_stages`` stack with its own optimizer state.
 
-    if stacked is None:
-        stacked = stack_trunk(variables, mesh.shape[PIPE_AXIS])
+    The trunk's per-block ``params`` / ``batch_stats`` / ``quant`` entries
+    leave ``params_g``/``batch_stats_g``/``quant_g`` (stage weights live
+    only on their stage's devices — the point of PP), ``opt_g`` is
+    re-initialized on the trunk-less tree (intended for training START:
+    fresh Adam state is zeros either way), and ``opt_s`` gets the same
+    optimizer over the stacked stage params. Per-leaf Adam makes the
+    split update trajectory identical to the fused one.
+    """
+    from p2p_tpu.train.state import make_optimizers
 
-    n_micro, mb = x_mb.shape[0], x_mb.shape[1]
+    prefix = trunk_prefix(cfg.model)
+    variables = {"params": state.params_g}
+    if state.batch_stats_g:
+        variables["batch_stats"] = state.batch_stats_g
+    if state.quant_g:
+        variables["quant"] = state.quant_g
+    stacked = place_trunk_pp(
+        stack_trunk(variables, mesh.shape[PIPE_AXIS], prefix=prefix), mesh)
 
-    def flat(t):
-        # [M, mb, ...] -> [mb*M, ...] *mb-major*: the data-sharded mb axis
-        # stays outermost so GSPMD keeps the encoder/decoder data-parallel
-        # (an M-major flatten interleaves the shards and forces XLA to
-        # all-gather the full batch onto every device)
-        return jnp.swapaxes(t, 0, 1).reshape((mb * n_micro,) + t.shape[2:])
+    def strip(tree):
+        if not tree:
+            return tree
+        return {k: v for k, v in tree.items() if not k.startswith(prefix)}
 
-    def unflat(t):
-        return jnp.swapaxes(
-            t.reshape((mb, n_micro) + t.shape[1:]), 0, 1)
-
-    x = flat(x_mb)
-
-    # --- encoder (replicated over pipe; flat batch) ---
-    y = pixel_unshuffle(x, 2)
-    y = upsample_nearest(y, 2)
-    y = act(norm_at(0, ConvLayer(cfg.ngf, kernel_size=9, use_bias=ub, dtype=dtype)
-                    .apply({"params": p["ConvLayer_0"]}, y)))
-    y = act(norm_at(1, ConvLayer(cfg.ngf * 2, kernel_size=3, stride=2,
-                                 use_bias=ub, dtype=dtype)
-                    .apply({"params": p["ConvLayer_1"]}, y)))
-    y = act(norm_at(2, ConvLayer(cfg.ngf * 4, kernel_size=3, stride=2,
-                                 use_bias=ub, dtype=dtype)
-                    .apply({"params": p["ConvLayer_2"]}, y)))
-
-    # --- pipelined residual trunk ---
-    residual = y
-    y_mb = gpipe_trunk(make_expand_block_apply(cfg, dtype), stacked,
-                       unflat(y), mesh)
-    y = leaky_relu_y(flat(y_mb) + residual, 0.2)
-
-    # --- decoder ---
-    y = act(norm_at(3, UpsampleConvLayer(cfg.ngf * 2, kernel_size=3,
-                                         upsample=2, use_bias=ub, dtype=dtype)
-                    .apply({"params": p["UpsampleConvLayer_0"]}, y)))
-    y = act(norm_at(4, UpsampleConvLayer(cfg.ngf, kernel_size=3, upsample=2,
-                                         use_bias=ub, dtype=dtype)
-                    .apply({"params": p["UpsampleConvLayer_1"]}, y)))
-    y = UpsampleConvLayer(cfg.output_nc, kernel_size=9, use_bias=ub,
-                                      dtype=dtype).apply(
-        {"params": p["UpsampleConvLayer_2"]}, y)
-    y = norm_at(5, y)
-    y = tanh_y(y)
-    return unflat(y)
+    params_rest = strip(state.params_g)
+    # optax transforms are stateless — ONE generator-family optimizer
+    # serves both the trunk-less tree and the stage stack
+    opt_g, _, _ = make_optimizers(cfg, steps_per_epoch)
+    return state.replace(
+        params_g=params_rest,
+        batch_stats_g=strip(state.batch_stats_g),
+        quant_g=(strip(state.quant_g)
+                 if state.quant_g is not None else None),
+        opt_g=opt_g.init(params_rest),
+        pp_stages=stacked,
+        opt_s=opt_g.init(stacked["params"]),
+    )

@@ -33,9 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
-
-from p2p_tpu.core.mesh import SPATIAL_AXIS
+from p2p_tpu.core.mesh import SPATIAL_AXIS, shard_map_compat as shard_map
 from p2p_tpu.parallel.halo import halo_exchange
 
 _DIMNUMS = ("NHWC", "HWIO", "NHWC")

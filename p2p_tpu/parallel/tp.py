@@ -31,6 +31,13 @@ sharding region covers the ``spatial`` axis, not channel shards — under TP
 the XLA norm partitions natively, the Pallas custom call would force a
 channel all-gather.
 
+Round 6: this is a TRAINER capability, not just a library mechanism — the
+CLI trainer builds :func:`tp_sharding_tree` over the whole TrainState when
+``--mesh`` sets ``model > 1`` and jits the step with explicit in/out
+shardings (train/loop.py; ``--tp_min_ch`` plumbs ``min_ch``). CLI-TP ==
+single-device is pinned per-preset in tests/test_loop.py on top of the
+step-level equivalence tests here.
+
 Single-chip note: this environment exposes ONE real TPU chip, so TP here is
 validated for numerics on the fake CPU mesh (tests/test_parallel.py) and
 compile-checked via the driver dryrun; multi-chip speedups are expected at

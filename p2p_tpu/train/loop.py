@@ -189,19 +189,23 @@ class Trainer:
         self.mesh = mesh if mesh is not None else (
             make_mesh(cfg.parallel.mesh) if use_mesh else None
         )
+        self._tp = False
         if self.mesh is not None:
             from p2p_tpu.core.mesh import MODEL_AXIS, PIPE_AXIS
 
-            for ax in (MODEL_AXIS, PIPE_AXIS):
-                if self.mesh.shape.get(ax, 1) > 1:
-                    # training still runs correctly (the axis is just
-                    # replicated) but those devices do duplicate work
-                    print(
-                        f"WARNING: mesh axis {ax!r}={self.mesh.shape[ax]}: "
-                        "the CLI trainer shards over data/spatial/time "
-                        "only — use parallel/tp.py (state_sharding) or "
-                        "parallel/pp.py APIs to actually exploit it",
-                        flush=True)
+            # model axis: the trainer builds the Megatron sharding tree
+            # below and trains genuinely tensor-parallel (parallel/tp.py)
+            self._tp = self.mesh.shape.get(MODEL_AXIS, 1) > 1
+            if self.mesh.shape.get(PIPE_AXIS, 1) > 1:
+                # training still runs correctly (the axis is just
+                # replicated) but those devices do duplicate work
+                print(
+                    f"WARNING: mesh axis 'pipe'="
+                    f"{self.mesh.shape[PIPE_AXIS]}: the CLI trainer does "
+                    "not pipeline — use train/step.build_pp_train_step + "
+                    "parallel/pp.pp_split_state (docs/PARALLELISM.md) to "
+                    "actually exploit it",
+                    flush=True)
         self.batch_sharding = batch_sharding(self.mesh) if self.mesh else None
         # Multi-host input: each process loads 1/process_count of the
         # GLOBAL batch (Grain shards records per process; device_prefetch
@@ -265,13 +269,25 @@ class Trainer:
             cfg, jax.random.key(cfg.train.seed), sample,
             self.steps_per_epoch, dtype,
         )
+        self.state_sharding = None
         if self.mesh is not None and self.mesh.size > 1:
-            # Replicate the state over the mesh (as VideoTrainer does):
-            # batches arrive committed to all mesh devices, and jit
-            # refuses to mix them with single-device state arrays.
-            from p2p_tpu.core.mesh import replicated
+            if self._tp:
+                # CLI-TP: Megatron channel shards on the conv pairs the
+                # pair rule covers, everything else replicated; the same
+                # tree feeds make_parallel_train_step's in/out shardings
+                # so updated states STAY sharded across steps.
+                from p2p_tpu.parallel.tp import tp_sharding_tree
 
-            self.state = jax.device_put(self.state, replicated(self.mesh))
+                self.state_sharding = tp_sharding_tree(
+                    self.state, self.mesh, min_ch=cfg.parallel.tp_min_ch)
+                self.state = jax.device_put(self.state, self.state_sharding)
+            else:
+                # Replicate the state over the mesh (as VideoTrainer does):
+                # batches arrive committed to all mesh devices, and jit
+                # refuses to mix them with single-device state arrays.
+                from p2p_tpu.core.mesh import replicated
+
+                self.state = jax.device_put(self.state, replicated(self.mesh))
         self._dtype = dtype
         self._build_step_fns()
         ckpt_dir = os.path.join(
@@ -315,16 +331,36 @@ class Trainer:
 
     def _build_step_fns(self) -> None:
         cfg = self.cfg
-        self.train_step = self._with_mesh(build_train_step(
-            cfg, self.vgg_params, self.steps_per_epoch, self._dtype
-        ))
-        self.multi_step = None
-        if cfg.train.scan_steps > 1:
-            from p2p_tpu.train.step import build_multi_train_step
+        if self.state_sharding is not None:
+            # CLI-TP path: the jit carries explicit in/out shardings so
+            # the TP-annotated state round-trips sharded and GSPMD plans
+            # the channel-shard collectives (parallel/dp.py + tp.py).
+            from p2p_tpu.parallel.dp import (
+                make_parallel_multi_train_step,
+                make_parallel_train_step,
+            )
 
-            self.multi_step = self._with_mesh(build_multi_train_step(
+            self.train_step = make_parallel_train_step(
+                cfg, self.mesh, self.vgg_params, self.steps_per_epoch,
+                self._dtype, state_sharding=self.state_sharding,
+            )
+            self.multi_step = None
+            if cfg.train.scan_steps > 1:
+                self.multi_step = make_parallel_multi_train_step(
+                    cfg, self.mesh, self.vgg_params, self.steps_per_epoch,
+                    self._dtype, state_sharding=self.state_sharding,
+                )
+        else:
+            self.train_step = self._with_mesh(build_train_step(
                 cfg, self.vgg_params, self.steps_per_epoch, self._dtype
             ))
+            self.multi_step = None
+            if cfg.train.scan_steps > 1:
+                from p2p_tpu.train.step import build_multi_train_step
+
+                self.multi_step = self._with_mesh(build_multi_train_step(
+                    cfg, self.vgg_params, self.steps_per_epoch, self._dtype
+                ))
         self.eval_step = self._with_mesh(build_eval_step(cfg, self._dtype))
         # Sample-dump-only helper: the reference saves the QUANTIZED
         # compressed intermediate next to input/target/pred each epoch

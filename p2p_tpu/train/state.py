@@ -47,6 +47,13 @@ class TrainState(struct.PyTreeNode):
     quant_g: Any = None
     quant_d: Any = None
     quant_c: Any = None
+    # Pipeline parallelism (parallel/pp.py pp_split_state): the generator
+    # trunk's stacked [S, B, ...] stage variables sharded over the `pipe`
+    # mesh axis, with their own optimizer state. None on every non-PP
+    # path — None flattens to an empty subtree, so existing checkpoints
+    # keep restoring bit-for-bit.
+    pp_stages: Any = None
+    opt_s: Optional[optax.OptState] = None
 
 
 def _zero_nonfinite() -> optax.GradientTransformation:

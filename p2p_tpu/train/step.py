@@ -120,6 +120,92 @@ def single_forward_d_losses(d_apply, dvars0, params_d, fake_pair,
     )
 
 
+def make_g_loss_fn(cfg: Config, vgg_params: Optional[Any] = None,
+                   steps_per_epoch: int = 1):
+    """The generator-side loss surface (GAN + feature-matching + VGG +
+    style + TV + angular + sobel + L1 per the config), factored out so the
+    standard step and the pipelined step (``build_pp_train_step``) share
+    ONE definition. Returns ``g_losses(fake_b, pred_fake_g, pred_real,
+    real_a, real_b, step) -> (total, parts)``; differentiation wrt
+    ``pred_fake_g`` routes the GAN + feature-matching cotangent back
+    through D."""
+    L = cfg.loss
+    need_vgg = (L.lambda_vgg > 0) and vgg_params is not None
+
+    def g_losses(fake_b, pred_fake_g, pred_real, real_a, real_b, step):
+        l_gan = gan_loss(pred_fake_g, True, L.gan_mode,
+                         for_discriminator=False)
+        parts = {"g_gan": l_gan}
+        total = l_gan
+        if L.lambda_feat > 0:
+            l_feat = feature_matching_loss(
+                pred_fake_g, pred_real, cfg.model.n_layers_D, L.lambda_feat
+            )
+            parts["g_feat"] = l_feat
+            total = total + l_feat
+        if need_vgg:
+            l_vgg = vgg_loss(
+                vgg_params, fake_b, real_b, L.vgg_imagenet_norm
+            ) * L.lambda_vgg
+            parts["g_vgg"] = l_vgg
+            total = total + l_vgg
+        if L.lambda_style > 0 and vgg_params is not None:
+            from p2p_tpu.losses.style import style_loss
+
+            l_style = style_loss(
+                vgg_params, fake_b, real_b, L.vgg_imagenet_norm
+            ) * L.lambda_style
+            parts["g_style"] = l_style
+            total = total + l_style
+        if L.lambda_tv > 0:
+            l_tv = total_variation_loss(fake_b) * L.lambda_tv
+            parts["g_tv"] = l_tv
+            total = total + l_tv
+        if L.lambda_angular > 0:
+            from p2p_tpu.ops.sobel import angular_loss
+
+            # The reference's commented experiment (train.py:356-360)
+            # compares ILLUMINATION QUOTIENTS, not raw images:
+            #   illum_gt   = real_a / max(real_b, 1e-4)
+            #   illum_pred = real_a / max(fake_b, 1e-4)
+            eps = jnp.asarray(1e-4, real_b.dtype)
+            illum_gt = real_a / jnp.maximum(real_b, eps)
+            illum_pred = real_a / jnp.maximum(fake_b, eps)
+            l_ang = angular_loss(illum_gt, illum_pred) * L.lambda_angular
+            parts["g_angular"] = l_ang
+            total = total + l_ang
+        if L.lambda_sobel > 0:
+            from p2p_tpu.ops.sobel import sobel_edges
+
+            lam = jnp.float32(L.lambda_sobel)
+            if L.sobel_warmup_epochs > 0:
+                # reference warmup shape (train.py:445-448):
+                # weight ramps linearly with the epoch index,
+                # saturating at lambda_sobel after warmup epochs
+                epoch = 1 + step // max(steps_per_epoch, 1)
+                lam = lam * jnp.minimum(
+                    epoch.astype(jnp.float32) / L.sobel_warmup_epochs,
+                    1.0,
+                )
+            l_sobel = jnp.mean(jnp.abs(
+                sobel_edges(fake_b) - sobel_edges(real_b)
+            )) * lam
+            parts["g_sobel"] = l_sobel
+            total = total + l_sobel
+        if L.lambda_l1 > 0:
+            # elementwise diff in the train dtype (bf16 cotangents),
+            # accumulation in f32 — halves the loss-side HBM traffic
+            # at 256²·bs128 vs an f32 elementwise chain.
+            l_l1 = jnp.mean(
+                jnp.abs(fake_b - real_b), dtype=jnp.float32
+            ) * L.lambda_l1
+            parts["g_l1"] = l_l1
+            total = total + l_l1
+        return total, parts
+
+    return g_losses
+
+
 def build_train_step(
     cfg: Config,
     vgg_params: Optional[Any] = None,
@@ -158,6 +244,7 @@ def build_train_step(
     # amax, ops/int8.py) through G and D exactly like batch_stats/spectral
     use_quant = cfg.model.int8_delayed
     d_colls = ("spectral", "quant") if use_quant else ("spectral",)
+    g_loss_fn = make_g_loss_fn(cfg, vgg_params, steps_per_epoch)
 
     def g_fwd(params, bstats, quant, x, rng=None):
         rngs = {"dropout": rng} if (use_dropout and rng is not None) else None
@@ -226,78 +313,12 @@ def build_train_step(
         use_pool = cfg.train.pool_size > 0 and state.pool is not None
         pool1, pool_n1 = state.pool, state.pool_n
 
-        # G-side loss terms, shared by both step structures. ``pred_fake_g``
-        # is the multiscale D output on (real_a ‖ fake_b); differentiation
-        # wrt it routes the GAN + feature-matching cotangent back through D.
+        # G-side loss terms (make_g_loss_fn — ONE definition shared with
+        # the pipelined step), shared by both step structures here.
+        # ``pred_fake_g`` is the multiscale D output on (real_a ‖ fake_b).
         def g_losses(fake_b, pred_fake_g):
-            l_gan = gan_loss(pred_fake_g, True, L.gan_mode, for_discriminator=False)
-            parts = {"g_gan": l_gan}
-            total = l_gan
-            if L.lambda_feat > 0:
-                l_feat = feature_matching_loss(
-                    pred_fake_g, pred_real, cfg.model.n_layers_D, L.lambda_feat
-                )
-                parts["g_feat"] = l_feat
-                total = total + l_feat
-            if need_vgg:
-                l_vgg = vgg_loss(
-                    vgg_params, fake_b, real_b, L.vgg_imagenet_norm
-                ) * L.lambda_vgg
-                parts["g_vgg"] = l_vgg
-                total = total + l_vgg
-            if L.lambda_style > 0 and vgg_params is not None:
-                from p2p_tpu.losses.style import style_loss
-
-                l_style = style_loss(
-                    vgg_params, fake_b, real_b, L.vgg_imagenet_norm
-                ) * L.lambda_style
-                parts["g_style"] = l_style
-                total = total + l_style
-            if L.lambda_tv > 0:
-                l_tv = total_variation_loss(fake_b) * L.lambda_tv
-                parts["g_tv"] = l_tv
-                total = total + l_tv
-            if L.lambda_angular > 0:
-                from p2p_tpu.ops.sobel import angular_loss
-
-                # The reference's commented experiment (train.py:356-360)
-                # compares ILLUMINATION QUOTIENTS, not raw images:
-                #   illum_gt   = real_a / max(real_b, 1e-4)
-                #   illum_pred = real_a / max(fake_b, 1e-4)
-                eps = jnp.asarray(1e-4, real_b.dtype)
-                illum_gt = real_a / jnp.maximum(real_b, eps)
-                illum_pred = real_a / jnp.maximum(fake_b, eps)
-                l_ang = angular_loss(illum_gt, illum_pred) * L.lambda_angular
-                parts["g_angular"] = l_ang
-                total = total + l_ang
-            if L.lambda_sobel > 0:
-                from p2p_tpu.ops.sobel import sobel_edges
-
-                lam = jnp.float32(L.lambda_sobel)
-                if L.sobel_warmup_epochs > 0:
-                    # reference warmup shape (train.py:445-448):
-                    # weight ramps linearly with the epoch index,
-                    # saturating at lambda_sobel after warmup epochs
-                    epoch = 1 + state.step // max(steps_per_epoch, 1)
-                    lam = lam * jnp.minimum(
-                        epoch.astype(jnp.float32) / L.sobel_warmup_epochs,
-                        1.0,
-                    )
-                l_sobel = jnp.mean(jnp.abs(
-                    sobel_edges(fake_b) - sobel_edges(real_b)
-                )) * lam
-                parts["g_sobel"] = l_sobel
-                total = total + l_sobel
-            if L.lambda_l1 > 0:
-                # elementwise diff in the train dtype (bf16 cotangents),
-                # accumulation in f32 — halves the loss-side HBM traffic
-                # at 256²·bs128 vs an f32 elementwise chain.
-                l_l1 = jnp.mean(
-                    jnp.abs(fake_b - real_b), dtype=jnp.float32
-                ) * L.lambda_l1
-                parts["g_l1"] = l_l1
-                total = total + l_l1
-            return total, parts
+            return g_loss_fn(fake_b, pred_fake_g, pred_real,
+                             real_a, real_b, state.step)
 
         if not use_pool:
             # ---- 2+3. ONE D(fake) forward serving both losses -----------
@@ -491,6 +512,254 @@ def build_train_step(
 
     if jit:
         step = jax.jit(step, donate_argnums=0)
+    return step
+
+
+def build_pp_train_step(
+    cfg: Config,
+    mesh,
+    n_micro: int,
+    vgg_params: Optional[Any] = None,
+    steps_per_epoch: int = 1,
+    train_dtype=None,
+    jit: bool = True,
+):
+    """The full alternating G/D(/C) train step with the generator's
+    residual trunk on the GPipe schedule over ``mesh``'s ``pipe`` axis.
+
+    ``state`` must be prepared by :func:`p2p_tpu.parallel.pp.pp_split_state`
+    (trunk variables stacked into pipe-sharded ``pp_stages`` with their own
+    optimizer state ``opt_s``); ``batch`` is the standard flat batch (data-
+    sharded), carved into ``n_micro`` microbatches mb-major inside the step.
+    Loss surface, D single-forward structure, and update order are the
+    unpipelined step's own (shared code: ``make_g_loss_fn``,
+    ``single_forward_d_losses``), so losses match it within the documented
+    norm-semantics bound (parallel/pp.py): exact for the instance-norm
+    family, eval-stat norms for BatchNorm models — ``batch_stats_g`` is not
+    advanced by this step. The delayed-int8 trunk's 'quant' scales ride the
+    stage stack and update exactly like the unpipelined step's
+    (ops/int8.py ``amax_update``).
+
+    v1 bounds (documented in docs/PARALLELISM.md): expand/resnet trunk
+    families only; no historical-fake pool.
+    """
+    from p2p_tpu.core.mesh import mesh_context
+    from p2p_tpu.parallel.pp import (
+        mb_major_flatten,
+        mb_major_unflatten,
+        pp_generator_forward,
+        trunk_prefix,
+    )
+
+    trunk_prefix(cfg.model)  # fail early on non-trunk generator families
+    if cfg.train.pool_size > 0:
+        raise ValueError(
+            "build_pp_train_step does not support the historical-fake "
+            "pool (pool_size > 0); run pooled configs unpipelined")
+    _, d, c = build_models(cfg, train_dtype)
+    opt_g, opt_d, opt_c = make_optimizers(cfg, steps_per_epoch)
+    # optax transforms are stateless: the generator optimizer also drives
+    # the stage stack — per-leaf Adam makes the split trajectory identical
+    # to the fused params_g one
+    opt_s = opt_g
+    L = cfg.loss
+    bits = cfg.model.quant_bits
+    quant = quantize_ste if cfg.model.quant_ste else quantize
+    use_c = cfg.model.use_compression_net
+    need_vgg = (L.lambda_vgg > 0) and vgg_params is not None
+    use_quant_d = cfg.model.int8_delayed
+    d_colls = ("spectral", "quant") if use_quant_d else ("spectral",)
+    g_loss_fn = make_g_loss_fn(cfg, vgg_params, steps_per_epoch)
+
+    def d_fwd(params, dvars, x):
+        out, mut = d.apply(
+            {"params": params, **dvars}, x, mutable=list(d_colls)
+        )
+        return out, {k: mut.get(k, {}) for k in d_colls}
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        if state.pp_stages is None:
+            raise ValueError(
+                "state has no pp_stages — prepare it with "
+                "parallel.pp.pp_split_state(state, cfg, mesh)")
+        real_a = ingest(batch["input"], train_dtype)
+        real_b = ingest(batch["target"], train_dtype)
+        n = int(real_a.shape[0])
+        if n % n_micro:
+            raise ValueError(
+                f"batch {n} not divisible by n_micro={n_micro}")
+        # mb-major carve (the ONE definition lives in parallel/pp.py): the
+        # data-sharded batch axis stays outermost so the microbatch slots
+        # align with the data shards
+        unflat = lambda t: mb_major_unflatten(t, n_micro)  # noqa: E731
+        flat = mb_major_flatten
+
+        # ---- 1. compression pre-filter + quantizer (unpipelined: <1% of
+        # the FLOPs; its BatchNorm keeps train-mode stats) ---------------
+        def compressed_fn(params_c):
+            raw, vc = c.apply(
+                {"params": params_c, "batch_stats": state.batch_stats_c},
+                real_b, True, mutable=["batch_stats"],
+            )
+            return quant(raw, bits), vc["batch_stats"]
+
+        if use_c:
+            compressed, bs_c1 = compressed_fn(state.params_c)
+        else:
+            compressed, bs_c1 = real_a, state.batch_stats_c
+        g_input = jax.lax.stop_gradient(compressed)
+
+        stages_aux = {k: v for k, v in state.pp_stages.items()
+                      if k != "params"}
+        has_q = "quant" in stages_aux
+
+        def g_pp(params_g, stages_p, x, quant_stack):
+            variables = {"params": params_g,
+                         "batch_stats": state.batch_stats_g}
+            stk = {"params": stages_p, **stages_aux}
+            if has_q:
+                stk["quant"] = quant_stack
+            out_mb, qnew = pp_generator_forward(
+                cfg.model, variables, unflat(x), mesh, stacked=stk,
+                dtype=train_dtype, with_quant=True)
+            return flat(out_mb), qnew
+
+        # ONE pipelined generator forward via explicit jax.vjp (the same
+        # single-forward structure as the unpipelined step): the backward
+        # re-enters the pipeline in reverse via the ppermute transpose.
+        def g_primal(params_g, stages_p):
+            out, qnew = g_pp(params_g, stages_p, g_input,
+                             stages_aux.get("quant"))
+            return out, qnew
+
+        fake_b_primal, g_vjp, quant_s1 = jax.vjp(
+            g_primal, state.params_g, state.pp_stages["params"],
+            has_aux=True,
+        )
+
+        # ---- 2+3. ONE D(fake) forward serving both losses --------------
+        dvars0 = {"spectral": state.spectral_d}
+        if use_quant_d:
+            dvars0["quant"] = state.quant_d
+        split = cfg.model.split_d_pairs
+        in_c = real_a.shape[-1]
+        if split:
+            fake_pair = (real_a, fake_b_primal)
+            real_pair = (real_a, real_b)
+        else:
+            fake_pair = _concat_pair(real_a, fake_b_primal)
+            real_pair = _concat_pair(real_a, real_b)
+        loss_d, grads_d, pred_fake, pred_real, dvars2, pull = (
+            single_forward_d_losses(
+                d_fwd, dvars0, state.params_d,
+                fake_pair, real_pair, L.gan_mode,
+            )
+        )
+
+        def g_losses(fake_b, pred_fake_g):
+            return g_loss_fn(fake_b, pred_fake_g, pred_real,
+                             real_a, real_b, state.step)
+
+        (loss_g, g_parts), (ct_fake_direct, ct_pred) = jax.value_and_grad(
+            g_losses, argnums=(0, 1), has_aux=True
+        )(fake_b_primal, pred_fake)
+        grad_fake = ct_fake_direct + (
+            pull(ct_pred)[1] if split else pull(ct_pred)[..., in_c:])
+        grads_g, grads_s = g_vjp(grad_fake)
+
+        # ---- 4. apply G (enc/dec + pipe-sharded stages) then D ---------
+        scale = state.lr_scale.astype(jnp.float32)
+        scale_tree = lambda ups: jax.tree_util.tree_map(  # noqa: E731
+            lambda u: u * scale.astype(u.dtype), ups
+        )
+        up_g, opt_g1 = opt_g.update(grads_g, state.opt_g, state.params_g)
+        params_g1 = optax.apply_updates(state.params_g, scale_tree(up_g))
+        up_s, opt_s1 = opt_s.update(grads_s, state.opt_s,
+                                    state.pp_stages["params"])
+        stages_p1 = optax.apply_updates(
+            state.pp_stages["params"], scale_tree(up_s))
+        up_d, opt_d1 = opt_d.update(grads_d, state.opt_d, state.params_d)
+        params_d1 = optax.apply_updates(state.params_d, scale_tree(up_d))
+
+        # ---- 5. compression branch vs the UPDATED pipelined generator --
+        loss_c = jnp.zeros((), jnp.float32)
+        params_c1, opt_c1 = state.params_c, state.opt_c
+        if use_c:
+            def loss_c_fn(params_c):
+                cq, _ = compressed_fn(params_c)
+                fake_ac, _ = g_pp(params_g1, stages_p1, cq, quant_s1)
+                loss = jnp.mean(
+                    (fake_ac.astype(jnp.float32)
+                     - real_b.astype(jnp.float32)) ** 2
+                )
+                if need_vgg:
+                    loss = loss + vgg_loss(
+                        vgg_params, cq, real_b, L.vgg_imagenet_norm
+                    ) * L.lambda_vgg
+                return loss
+
+            loss_c, grads_c = jax.value_and_grad(loss_c_fn)(state.params_c)
+            if cfg.optim.train_compression_net:
+                up_c, opt_c1 = opt_c.update(grads_c, state.opt_c,
+                                            state.params_c)
+                params_c1 = optax.apply_updates(
+                    state.params_c, scale_tree(up_c))
+
+        pp_stages1 = {"params": stages_p1, **stages_aux}
+        if has_q:
+            pp_stages1["quant"] = quant_s1
+        new_state = state.replace(
+            step=state.step + 1,
+            params_g=params_g1,
+            opt_g=opt_g1,
+            pp_stages=pp_stages1,
+            opt_s=opt_s1,
+            params_d=params_d1,
+            spectral_d=dvars2["spectral"],
+            opt_d=opt_d1,
+            params_c=params_c1,
+            batch_stats_c=bs_c1,
+            opt_c=opt_c1,
+            quant_d=dvars2.get("quant") if use_quant_d else None,
+        )
+        metrics = {
+            "loss_d": loss_d.astype(jnp.float32),
+            "loss_g": loss_g.astype(jnp.float32),
+            "loss_c": loss_c,
+            **{k: v.astype(jnp.float32) for k, v in g_parts.items()},
+        }
+        # same debug surface as build_train_step — the obs flags must not
+        # silently no-op just because the generator is pipelined
+        if cfg.debug.grad_norms:
+            from p2p_tpu.obs.taps import grad_norm_taps
+
+            grad_norm_taps(metrics,
+                           g={"rest": grads_g, "stages": grads_s},
+                           d=grads_d, c=grads_c if use_c else None)
+        if cfg.debug.nan_sentinel:
+            from p2p_tpu.obs.taps import nan_sentinel
+
+            nan_sentinel({**metrics, "lr_scale": scale},
+                         tag="pp_train_step")
+        if cfg.optim.grad_clip > 0:
+            from p2p_tpu.train.state import count_nonfinite
+
+            metrics["nonfinite_g"] = (
+                count_nonfinite(grads_g) + count_nonfinite(grads_s)
+            ).astype(jnp.float32)
+            metrics["nonfinite_d"] = count_nonfinite(grads_d).astype(
+                jnp.float32)
+            if use_c:
+                metrics["nonfinite_c"] = count_nonfinite(grads_c).astype(
+                    jnp.float32)
+        return new_state, metrics
+
+    if jit:
+        def step_in_mesh(state, batch):
+            with mesh_context(mesh):
+                return step(state, batch)
+
+        return jax.jit(step_in_mesh, donate_argnums=0)
     return step
 
 

@@ -11,10 +11,14 @@ import numpy as np
 import pytest
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
-
 from p2p_tpu.core.config import get_preset
-from p2p_tpu.core.mesh import MeshSpec, batch_sharding, make_mesh, replicated
+from p2p_tpu.core.mesh import (
+    MeshSpec,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_map_compat as shard_map,
+)
 from p2p_tpu.parallel import (
     halo_exchange,
     make_parallel_train_step,
@@ -294,9 +298,13 @@ def _run_tp_equivalence(cfg, mesh, batch, min_ch, sharded_probes):
     tp_state, tp_metrics = tp_step(tp_state, shard_batch(batch, mesh))
 
     for k in ref_metrics:
+        # 8e-4: the λ=100-scaled L1 rows sit at ~5e-4 relative on the
+        # 0.4.x CPU backend (GSPMD psum reduction order) — observed on
+        # the untouched round-5 tree the first time this suite became
+        # runnable under that jax; the newer vma-era backend lands ~3e-4
         np.testing.assert_allclose(
             float(ref_metrics[k]), float(tp_metrics[k]),
-            rtol=3e-4, atol=3e-4, err_msg=k)
+            rtol=8e-4, atol=8e-4, err_msg=k)
     for tree_name in ("params_g", "params_d"):
         for la, lb in zip(
             jax.tree_util.tree_leaves(getattr(ref_state, tree_name)),

@@ -110,13 +110,76 @@ def test_pp_forward_bitwise_layout_variants(devices8, overrides):
     assert np.array_equal(np.asarray(out), _ref_per_microbatch(g, v, x_mb))
 
 
-def test_pp_int8_trunk_rejected(devices8):
-    """pp v1 declines the int8 trunk loudly (its 'quant' scale collection
-    is not stacked) instead of crashing inside flax."""
-    mcfg, _, v, x = _setup(n_blocks=4, int8=True, int8_generator=True)
+def test_pp_int8_delayed_trunk_pipelines(devices8):
+    """The delayed-int8 trunk pipelines: stack_trunk stacks the 'quant'
+    scale collection, every microbatch quantizes with the FROZEN
+    start-of-step scale, and the max-combined amax proposals reproduce the
+    unpipelined full-batch update (ops/int8.py amax_update — this was the
+    round-5 parallel/pp.py scope guard, now a working path)."""
+    from p2p_tpu.parallel.pp import pp_generator_forward
+
+    mcfg, g, v, x = _setup(n_blocks=2, int8=True, int8_generator=True,
+                           int8_delayed=True)
+    assert "quant" in v
     mesh = make_mesh(MeshSpec(data=1, pipe=2), devices=devices8[:2])
-    with pytest.raises(NotImplementedError, match="int8"):
-        pp_expand_forward(mcfg, v, x.reshape(4, 2, 32, 32, 3), mesh)
+    x_mb = x.reshape(4, 2, 32, 32, 3)
+    st = stack_trunk(v, 2)
+    assert "quant" in st
+    out, qnew = jax.jit(lambda vr, stk, xm: pp_generator_forward(
+        mcfg, vr, xm, mesh, stacked=stk, with_quant=True))(v, st, x_mb)
+
+    # forward vs the unpipelined apply on the SAME mb-major flat batch
+    # (frozen scales; the encoder is batch-layout sensitive at ~1 ulp and
+    # int8 rounding can amplify a boundary flip — same relative bound as
+    # the direct-trunk tests)
+    vv = {"params": v["params"], "batch_stats": v.get("batch_stats", {}),
+          "quant": v["quant"]}
+    flat = jnp.swapaxes(x_mb, 0, 1).reshape((8,) + x_mb.shape[2:])
+    ref_flat, mut = jax.jit(lambda xf: g.apply(
+        vv, xf, False, mutable=["quant"]))(flat)
+    ref = np.asarray(jnp.swapaxes(
+        ref_flat.reshape((2, 4) + x_mb.shape[2:]), 0, 1))
+    scale = max(np.abs(ref).max(), 1.0)
+    assert np.abs(np.asarray(out) - ref).max() <= 1e-6 * scale
+
+    # quant update == the full-batch mutable apply's update (max of maxes)
+    ref_q = stack_trunk({"params": v["params"], "quant": mut["quant"]},
+                        2)["quant"]
+    for a, b in zip(jax.tree.leaves(ref_q), jax.tree.leaves(qnew)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=0)
+
+
+def test_pp_no_full_activation_allgather(devices8):
+    """HLO pin for the mb-major flatten (ADVICE r5 #1): lowering the
+    pipelined forward on a data=2 x pipe=2 mesh must not all-gather any
+    tensor as large as the full activation — the data-sharded mb axis
+    stays outermost through flat/unflat, so the encoder/decoder stay
+    data-parallel. Mirrors the spatial pin at tests/test_ops.py."""
+    import re
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mcfg, _, v, x = _setup(norm="batch", n_blocks=4)
+    mesh = make_mesh(MeshSpec(data=2, pipe=2), devices=devices8[:4])
+    x_mb = jax.device_put(
+        x.reshape(4, 2, 32, 32, 3),
+        NamedSharding(mesh, P(None, "data", None, None, None)))
+    stacked = place_trunk_pp(stack_trunk(v, 2), mesh)
+
+    hlo = jax.jit(lambda vr, st, xm: pp_expand_forward(
+        mcfg, vr, xm, mesh, stacked=st)).lower(
+            v, stacked, x_mb).compile().as_text()
+    # full activation: 8 images x 32 x 32 x 3 (encoder widths only grow
+    # the channel dim after spatial halving — batch x spatial extent is
+    # the sharded quantity). Match EVERY shape on any all-gather /
+    # all-gather-start line (async forms carry tuple shapes).
+    full = 8 * 32 * 32 * 3
+    for ln in (l for l in hlo.splitlines() if "all-gather" in l):
+        for m in re.finditer(r"\w+\[([\d,]+)\]", ln):
+            dims = [int(d) for d in m.group(1).split(",") if d]
+            numel = int(np.prod(dims)) if dims else 0
+            assert numel < full, (numel, ln)
 
 
 def test_pp_single_stage_degenerate(devices8):
@@ -266,3 +329,117 @@ def test_pp_training_reduces_loss(devices8):
     # stage weights stayed pipe-sharded through the updates
     leaf = params["stages"]["params"]["ConvLayer_0"]["Conv_0"]["kernel"]
     assert "pipe" in str(leaf.sharding.spec)
+
+
+# ------------------------------------------------- full-GAN PP train step
+
+
+def _pp_gan_cfg(n_blocks=4, batch=4):
+    cfg = get_preset("reference")
+    return cfg.replace(
+        model=dataclasses.replace(
+            cfg.model, ngf=8, ndf=8, n_blocks=n_blocks, num_D=2,
+            n_layers_D=2, norm="instance"),
+        loss=dataclasses.replace(cfg.loss, lambda_vgg=0.0),
+        data=dataclasses.replace(cfg.data, batch_size=batch, image_size=32),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+    )
+
+
+def test_pp_split_state_moves_trunk_to_stages(devices8):
+    """pp_split_state: trunk variables leave params_g for the pipe-sharded
+    pp_stages stack, opt_s mirrors the stacked params, and the remaining
+    tree keeps its optimizer structure."""
+    from p2p_tpu.parallel.pp import pp_split_state
+    from p2p_tpu.train.state import create_train_state
+
+    cfg = _pp_gan_cfg()
+    rng = np.random.default_rng(0)
+    batch = {k: jnp.asarray(rng.uniform(-1, 1, (4, 32, 32, 3)), jnp.float32)
+             for k in ("input", "target")}
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    mesh = make_mesh(MeshSpec(data=2, pipe=2), devices=devices8[:4])
+    pp_state = pp_split_state(state, cfg, mesh)
+    assert not any(k.startswith("ResidualBlock_") for k in pp_state.params_g)
+    assert pp_state.pp_stages is not None and pp_state.opt_s is not None
+    k0 = pp_state.pp_stages["params"]["ConvLayer_0"]["Conv_0"]["kernel"]
+    assert k0.shape[:2] == (2, 2)  # [S, B] for 4 blocks / 2 stages
+    assert "pipe" in str(k0.sharding.spec)
+    # non-PP states keep the new optional fields empty (checkpoint compat)
+    assert state.pp_stages is None and state.opt_s is None
+
+
+@pytest.mark.slow
+def test_pp_full_gan_step_matches_unpipelined(devices8):
+    """The tentpole pin: build_pp_train_step — the COMPLETE alternating
+    G/D/C update with the generator trunk on the GPipe schedule over a
+    data=2 x pipe=2 mesh — matches the unpipelined build_train_step on the
+    same batch within the documented norm-semantics bound (exact family:
+    instance norm), and the updated stage weights match the oracle's
+    trunk params re-stacked."""
+    from p2p_tpu.parallel.dp import replicate_state, shard_batch
+    from p2p_tpu.parallel.pp import pp_split_state
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_pp_train_step, build_train_step
+
+    cfg = _pp_gan_cfg()
+    mesh = make_mesh(MeshSpec(data=2, pipe=2), devices=devices8[:4])
+    rng = np.random.default_rng(1)
+    batch = {k: jnp.asarray(rng.uniform(-1, 1, (4, 32, 32, 3)), jnp.float32)
+             for k in ("input", "target")}
+    state = create_train_state(cfg, jax.random.key(0), batch)
+
+    ref_step = build_train_step(cfg)
+    ref_state, ref_metrics = ref_step(
+        jax.tree_util.tree_map(jnp.copy, state), dict(batch))
+
+    pp_state = pp_split_state(replicate_state(state, mesh), cfg, mesh)
+    pp_step = build_pp_train_step(cfg, mesh, n_micro=2)
+    pp_state, pp_metrics = pp_step(pp_state, shard_batch(batch, mesh))
+
+    for k in ref_metrics:
+        np.testing.assert_allclose(
+            float(ref_metrics[k]), float(pp_metrics[k]),
+            rtol=2e-4, atol=2e-4, err_msg=k)
+    # updated trunk params: oracle's ResidualBlock_i re-stacked == the
+    # pipe-sharded stage stack after the opt_s update
+    ref_stack = stack_trunk({"params": ref_state.params_g}, 2)["params"]
+    for a, b in zip(jax.tree.leaves(ref_stack),
+                    jax.tree.leaves(pp_state.pp_stages["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+    # encoder/decoder + D params match too
+    rest_ref = {k: v for k, v in ref_state.params_g.items()
+                if not k.startswith("ResidualBlock_")}
+    for tree_a, tree_b in ((rest_ref, pp_state.params_g),
+                           (ref_state.params_d, pp_state.params_d)):
+        for a, b in zip(jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+    # stage weights stayed pipe-sharded through the update
+    leaf = pp_state.pp_stages["params"]["ConvLayer_0"]["Conv_0"]["kernel"]
+    assert "pipe" in str(leaf.sharding.spec)
+
+
+@pytest.mark.slow
+def test_pp_resnet_generator_forward(devices8):
+    """pp_generator_forward on the ResNet family (cityscapes-class G —
+    the HD trunk where PP pays): the module-backed pipelined forward
+    matches the per-microbatch unpipelined apply within the instance-norm
+    fusion bound (~1 ulp, same bound as the direct-trunk tests)."""
+    from p2p_tpu.parallel.pp import pp_generator_forward
+
+    cfg = get_preset("cityscapes_spatial")
+    mcfg = dataclasses.replace(cfg.model, ngf=8, n_blocks=4)
+    g = define_G(mcfg)
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.uniform(-1, 1, (4, 32, 32, 3)), jnp.float32)
+    v = init_variables(g, jax.random.key(9), x, mcfg.init_type,
+                       mcfg.init_gain, train=False)
+    mesh = make_mesh(MeshSpec(data=1, pipe=2), devices=devices8[:2])
+    x_mb = x.reshape(2, 2, 32, 32, 3)
+    out = jax.jit(lambda vr, xm: pp_generator_forward(
+        mcfg, vr, xm, mesh))(v, x_mb)
+    ref = _ref_per_microbatch(g, v, x_mb)
+    scale = max(np.abs(ref).max(), 1.0)
+    assert np.abs(np.asarray(out) - ref).max() <= 1e-6 * scale
