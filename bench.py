@@ -31,6 +31,12 @@ the band floor — the standing perf-regression gate (VERDICT r5 #7).
 ``--sweep --dry-run`` shrinks every row to toy dims and skips the band
 check: a CPU-able plumbing test that each contract config still builds,
 steps, and reports (CI runs it).
+
+``--infer`` is the standing INFERENCE headline row: the serving engine
+(p2p_tpu.serve — AOT bucket-batched generator inference with pipelined
+PNG output) on synthetic data, reported with the fenced breakdown
+(end-to-end img/s, device img/s, encode overlap, compiles-per-bucket).
+``--infer --dry-run`` is its CPU-able CI plumbing row.
 """
 
 from __future__ import annotations
@@ -260,6 +266,106 @@ def run_single(tiny: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --infer: the standing inference headline row (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+def run_infer(tiny: bool = False) -> dict:
+    """Serving-engine throughput: AOT bucket-batched generator inference
+    with pipelined PNG output (p2p_tpu.serve.InferenceEngine), reported
+    with the fenced StepTimer breakdown — img/s end-to-end, device-only
+    img/s, encode overlap, and compiles-per-bucket (must equal the bucket
+    count: the bucketing contract this row stands guard over).
+
+    Env knobs: BENCH_PRESET (default facades_int8 — same generator as the
+    train headline), BENCH_BS (default 64 on TPU), BENCH_IMG, BENCH_STEPS
+    (number of full batches; a half-size tail batch is always appended to
+    exercise the bucket router), BENCH_INFER_DTYPE (bf16|f32, default
+    bf16), BENCH_INFER_SAVE=0 to skip PNG output (pure device number).
+    """
+    import tempfile
+
+    import jax
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.serve import InferenceEngine
+    from p2p_tpu.train.state import create_infer_state
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    preset = os.environ.get("BENCH_PRESET", "facades_int8")
+    cfg = get_preset(preset)
+    facades_like = preset in ("facades", "facades_int8")
+    if tiny:
+        img, wid = 32, (64 if cfg.data.image_width else None)
+        bs, n_batches = 2, 2
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, ngf=8, ndf=8, num_D=min(cfg.model.num_D, 2),
+            n_layers_D=2, n_blocks=min(cfg.model.n_blocks, 2)))
+    else:
+        # same shape rule as run_single: BENCH_IMG forces square,
+        # otherwise non-default presets serve at their NATIVE dims
+        # (pix2pixhd 1024×512 — the HD generators assume W > H)
+        if "BENCH_IMG" in os.environ or facades_like or not on_tpu:
+            img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
+            wid = None
+        else:
+            img, wid = cfg.data.image_size, cfg.data.image_width
+        bs = int(os.environ.get("BENCH_BS", "64" if on_tpu else "2"))
+        n_batches = int(os.environ.get("BENCH_STEPS",
+                                       "32" if on_tpu else "4"))
+    dtype = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+    save = os.environ.get("BENCH_INFER_SAVE", "1") == "1"
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, test_batch_size=bs, image_size=img, image_width=wid))
+
+    tail = max(1, bs // 2)
+    buckets = tuple(sorted({tail, bs}))
+    u8 = cfg.data.uint8_pipeline
+    host = synthetic_batch(batch_size=bs, size=img,
+                           bits=cfg.model.quant_bits, width=wid,
+                           dtype="uint8" if u8 else "float32")
+    state = create_infer_state(cfg, jax.random.key(0), host)
+    engine = InferenceEngine(cfg, state, buckets=buckets, dtype=dtype,
+                             with_metrics=False)
+
+    def batches():
+        for _ in range(n_batches):
+            yield host
+        # the tail batch: routes to the smaller bucket, never a recompile
+        yield {k: v[:tail] for k, v in host.items()}
+
+    out_dir = tempfile.mkdtemp(prefix="bench_infer_") if save else None
+    from p2p_tpu.obs import span
+
+    with span("bench_infer"):
+        stats, _ = engine.run(batches(), out_dir=out_dir)
+    dims = f"{img}x{wid}" if wid else f"{img}px"
+    record = {
+        "metric": f"infer_throughput_{preset}_{dtype}_{platform}_{dims}_bs{bs}",
+        "value": round(stats.img_per_sec, 2),
+        "unit": "img/sec/chip",
+        **stats.as_dict(),
+    }
+    # contract gate BEFORE the metrics mirror: a run that recompiled
+    # mid-serve must not append its (broken) row to the standing stream —
+    # and must fail under `python -O` too, so no bare assert
+    if stats.n_compiles != len(buckets):
+        raise RuntimeError(
+            f"bucket contract broken: {stats.n_compiles} compiles for "
+            f"{len(buckets)} buckets")
+    if os.environ.get("BENCH_JSONL"):
+        from p2p_tpu.obs import JSONLSink, MetricsRegistry
+
+        reg = MetricsRegistry()
+        sink = JSONLSink(os.environ["BENCH_JSONL"])
+        reg.add_sink(sink)
+        reg.record({"kind": "bench_infer", **record}, force=True)
+        sink.close()
+    return record
+
+
+# ---------------------------------------------------------------------------
 # --sweep: the standing perf-regression gate (VERDICT r5 #7)
 # ---------------------------------------------------------------------------
 
@@ -352,10 +458,17 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep", action="store_true",
                     help="run all six BASELINE.md contract rows and fail "
                          "on >3% regression below the recorded band")
+    ap.add_argument("--infer", action="store_true",
+                    help="bench the serving engine instead of the train "
+                         "step: AOT bucket-batched inference + pipelined "
+                         "PNG output, fenced breakdown (docs/SERVING.md)")
     ap.add_argument("--dry-run", action="store_true",
-                    help="with --sweep: toy dims, plumbing check only "
-                         "(CPU-able; no band comparison)")
+                    help="with --sweep/--infer: toy dims, plumbing check "
+                         "only (CPU-able; no band comparison)")
     args = ap.parse_args(argv)
+    if args.infer:
+        print(json.dumps(run_infer(tiny=args.dry_run)))
+        return 0
     if args.sweep:
         return run_sweep(dry_run=args.dry_run)
     print(json.dumps(run_single()))

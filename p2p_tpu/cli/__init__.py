@@ -3,8 +3,10 @@
 - ``python -m p2p_tpu.cli.train`` — training (reference train.py:133-157
   flag parity + TPU mesh/preset knobs).
 - ``python -m p2p_tpu.cli.infer`` — batched inference from a checkpoint
-  (replaces reference test.py, which could not load train.py's checkpoints
-  — SURVEY Q5).
+  through the serving engine (replaces reference test.py, which could not
+  load train.py's checkpoints — SURVEY Q5).
+- ``python -m p2p_tpu.cli.serve`` — micro-batching serving frontend
+  (directory-driven requests → bucket-batched predictions; docs/SERVING.md).
 - ``python -m p2p_tpu.cli.generate_dataset`` — offline paired-dataset
   generation (reference generate_dataset.py:150-165 flag parity).
 """

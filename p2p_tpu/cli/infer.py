@@ -1,19 +1,23 @@
 """Inference CLI — batched generator inference from a training checkpoint.
 
 Replaces the reference's test.py (test.py:1-46), which loads a pickled
-module file train.py never writes (SURVEY Q5). Here inference restores the
-SAME Orbax checkpoint the trainer saves, rebuilds the generator from the
-SAME config preset, and runs the eval path (compression net + quantizer
-when the preset has one, plain G otherwise) over the test split, saving
-predictions to ``result/<dataset>/`` exactly like the reference driver.
+module file train.py never writes (SURVEY Q5). Inference restores from the
+SAME Orbax checkpoint the trainer saves — but through the serving engine
+(p2p_tpu.serve): a params-only subtree restore (never materializing the
+discriminator or optimizer state), a small set of AOT-compiled batch
+buckets (the final partial batch pads up to a bucket instead of
+recompiling), and thread-pooled PNG encoding that overlaps device compute.
 
 Flag parity with test.py (--dataset/--direction/--cuda) plus checkpoint
-addressing by step (--step, default latest).
+addressing by step (--step, default latest). ``--ndf``/``--pool_size`` are
+accepted-but-ignored (like --cuda): the params-only restore no longer needs
+discriminator/pool hyperparameters to rebuild a checkpoint template.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -39,18 +43,65 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image_size", type=int, default=None)
     p.add_argument("--ngf", type=int, default=None)
     p.add_argument("--ndf", type=int, default=None,
-                   help="discriminator width — needed to rebuild the "
-                        "checkpoint template for full-state restore")
+                   help="image presets: accepted-but-ignored (params-only "
+                        "restore never rebuilds the discriminator); video "
+                        "presets still restore the FULL state and need "
+                        "the trained value")
     p.add_argument("--n_blocks", type=int, default=None)
     p.add_argument("--upsample_mode", type=str, default=None,
                    choices=["deconv", "resize"])
     p.add_argument("--metrics", action="store_true",
                    help="also print mean/max PSNR+SSIM vs the targets")
     p.add_argument("--pool_size", type=int, default=None,
-                   help="pool size the checkpoint was TRAINED with — needed "
-                        "to rebuild the state template for full-state "
-                        "restore (like --ndf)")
+                   help="image presets: accepted-but-ignored (params-only "
+                        "restore never rebuilds the fake pool); video "
+                        "presets still restore the FULL state and need "
+                        "the trained value")
+    # --- serving-engine knobs (p2p_tpu.serve; docs/SERVING.md) -----------
+    p.add_argument("--buckets", type=str, default=None,
+                   help="comma-separated batch buckets AOT-compiled at "
+                        "startup (default: the test batch size; the tail "
+                        "batch pads up to the smallest covering bucket)")
+    p.add_argument("--dtype", type=str, default="bf16",
+                   choices=["bf16", "f32"],
+                   help="inference compute dtype policy (params stay f32; "
+                        "delayed-int8 checkpoints additionally serve with "
+                        "frozen activation scales)")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="serving mesh 'data,spatial,time[,model]': "
+                        "model>1 shards the generator tensor-parallel "
+                        "(parallel/tp.py)")
+    p.add_argument("--tp_min_ch", type=int, default=None,
+                   help="smallest channel count the TP rule shards")
+    p.add_argument("--io_threads", type=int, default=4,
+                   help="PNG encode worker threads (overlap device compute)")
+    p.add_argument("--compilation_cache", type=str, default=None,
+                   metavar="DIR",
+                   help="persistent XLA compilation cache dir: cold starts "
+                        "load compiled bucket programs from disk")
+    p.add_argument("--stats", action="store_true",
+                   help="print the engine's fenced timing breakdown as a "
+                        "JSON line (img/s, infer/encode/wall sec, compiles)")
     return p
+
+
+def _parse_mesh(arg):
+    if arg is None:
+        return None
+    from p2p_tpu.core.mesh import MeshSpec, make_mesh
+
+    try:
+        vals = [int(v) for v in arg.split(",")]
+        if not 3 <= len(vals) <= 5:
+            raise ValueError("need 3-5 axes")
+        while len(vals) < 5:
+            vals.append(1)
+        d, s, t, m, pp = vals
+    except ValueError:
+        raise SystemExit(
+            f"--mesh must be 'data,spatial,time[,model[,pipe]]' "
+            f"comma-separated ints (got {arg!r})")
+    return make_mesh(MeshSpec(data=d, spatial=s, time=t, model=m, pipe=pp))
 
 
 def main(argv=None) -> int:
@@ -61,33 +112,38 @@ def main(argv=None) -> int:
 
     import dataclasses
 
-    import jax
-
     from p2p_tpu.core.config import get_preset
     from p2p_tpu.data.pipeline import PairedImageDataset, make_loader
-    from p2p_tpu.train.checkpoint import CheckpointManager
-    from p2p_tpu.train.state import create_train_state
-    from p2p_tpu.train.step import build_eval_step
-    from p2p_tpu.utils.images import save_img
+    from p2p_tpu.serve import engine_from_checkpoint
 
     from p2p_tpu.cli import apply_overrides as over
 
     cfg = get_preset(args.preset)
     data = over(cfg.data, dataset=args.dataset, direction=args.direction,
                 test_batch_size=args.batch_size, image_size=args.image_size)
-    model = over(cfg.model, ngf=args.ngf, ndf=args.ndf,
-                 n_blocks=args.n_blocks, upsample_mode=args.upsample_mode)
-    train = over(cfg.train, pool_size=args.pool_size)
-    cfg = dataclasses.replace(cfg, data=data, model=model, train=train,
+    model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks,
+                 upsample_mode=args.upsample_mode)
+    cfg = dataclasses.replace(cfg, data=data, model=model,
                               name=args.name or cfg.name)
     if cfg.data.n_frames > 1:
-        return _video_main(args, cfg)
+        # the video path restores the FULL TrainState (its own pytree), so
+        # the template-rebuild knobs stay live there
+        model = over(cfg.model, ndf=args.ndf)
+        train = over(cfg.train, pool_size=args.pool_size)
+        return _video_main(args, dataclasses.replace(cfg, model=model,
+                                                     train=train))
+    for flag in ("ndf", "pool_size"):
+        if getattr(args, flag) is not None:
+            print(f"note: --{flag} accepted for parity but ignored — "
+                  "params-only restore needs no checkpoint template "
+                  "beyond the generator", file=sys.stderr)
 
     root = args.data_root or os.path.join(cfg.data.root, cfg.data.dataset)
+    ds_dtype = "uint8" if cfg.data.uint8_pipeline else "float32"
     try:
         ds = PairedImageDataset(
             root, "test", cfg.data.direction, cfg.data.image_size,
-            cfg.data.image_width,
+            cfg.data.image_width, dtype=ds_dtype,
         )
     except (RuntimeError, FileNotFoundError) as e:
         print(f"no test images under {root}: {e}", file=sys.stderr)
@@ -96,57 +152,59 @@ def main(argv=None) -> int:
     ckpt_dir = os.path.join(
         args.workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
     )
-    ckpt = CheckpointManager(ckpt_dir)
-    step = args.step if args.step is not None else ckpt.latest_step()
-    if step is None:
-        print(f"no checkpoint found under {ckpt_dir}", file=sys.stderr)
-        return 1
-
-    sample = ds[0]
     bs = cfg.data.test_batch_size
+    sample = ds[0]
     sample_batch = {
         k: np.broadcast_to(v, (bs,) + v.shape).copy() for k, v in sample.items()
     }
-    state = create_train_state(cfg, jax.random.key(0), sample_batch)
-    state = ckpt.restore(state, step)
-    eval_step = build_eval_step(cfg)
+    buckets = ([int(b) for b in args.buckets.split(",")] if args.buckets
+               else None)
+    try:
+        engine, step = engine_from_checkpoint(
+            cfg, ckpt_dir, sample_batch, step=args.step,
+            buckets=buckets or (bs,), dtype=args.dtype,
+            mesh=_parse_mesh(args.mesh), tp_min_ch=args.tp_min_ch,
+            # only compile the PSNR/SSIM tail into the bucket programs
+            # when asked — metrics-off serving must not pay for them
+            with_metrics=args.metrics,
+            compilation_cache_dir=args.compilation_cache,
+            io_workers=args.io_threads,
+        )
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
 
     out_dir = args.out or os.path.join(
         args.workdir, cfg.train.result_dir, cfg.data.dataset
     )
     os.makedirs(out_dir, exist_ok=True)
 
-    n_saved = 0
-    psnrs, ssims = [], []
-    # drop_remainder=False: EVERY test image gets a prediction (the final
-    # partial batch costs one extra compile at its smaller shape)
-    for batch in make_loader(ds, bs, shuffle=False, num_epochs=1,
-                             drop_remainder=False):
-        pred, metrics = eval_step(state, batch)
-        pred = np.asarray(pred, np.float32)
-        if args.metrics:
-            psnrs.extend(np.asarray(metrics["psnr"]).ravel().tolist())
-            ssims.extend(np.asarray(metrics["ssim"]).ravel().tolist())
-        for i in range(pred.shape[0]):
-            name = ds.names[n_saved] if n_saved < len(ds.names) else f"{n_saved}.png"
-            save_img(pred[i], os.path.join(out_dir, name))
-            n_saved += 1
-            if n_saved >= len(ds):
-                break
-        if n_saved >= len(ds):
-            break
-    print(f"wrote {n_saved} predictions (checkpoint step {step}) to {out_dir}")
-    if args.metrics and psnrs:
+    # drop_remainder=False: EVERY test image gets a prediction — the final
+    # partial batch pads up to a compiled bucket (no tail recompile) and
+    # its padding rows are masked out of files and metrics
+    loader = make_loader(ds, bs, shuffle=False, num_epochs=1,
+                         drop_remainder=False)
+    stats, metrics = engine.run(
+        loader, names=ds.names, out_dir=out_dir,
+        collect_metrics=args.metrics,
+    )
+    print(f"wrote {stats.n_images} predictions (checkpoint step {step}) "
+          f"to {out_dir}")
+    if args.metrics and metrics.get("psnr"):
+        psnrs, ssims = metrics["psnr"], metrics["ssim"]
         print(f"psnr_mean={np.mean(psnrs):.4f} psnr_max={np.max(psnrs):.4f} "
               f"ssim_mean={np.mean(ssims):.4f} ssim_max={np.max(ssims):.4f}")
+    if args.stats:
+        print(json.dumps({"kind": "serve_stats", **stats.as_dict()}))
     return 0
 
 
 def _video_main(args, cfg) -> int:
     """Clip inference: per-frame predictions written as
-    <out>/<video>_<frame>.png (video configs, n_frames>1)."""
+    <out>/<video>_<frame>.png (video configs, n_frames>1). Stays on the
+    full-state restore path — the video TrainState has its own structure;
+    engine coverage is image presets (docs/SERVING.md)."""
     import jax
-    import numpy as np
 
     from p2p_tpu.data.pipeline import make_loader
     from p2p_tpu.data.video import VideoClipDataset

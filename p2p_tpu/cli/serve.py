@@ -1,0 +1,264 @@
+"""Serving CLI — a micro-batching frontend over the inference engine.
+
+``python -m p2p_tpu.cli.serve`` watches a directory of request images
+(raw files are the "RPC": drop an image in, get its translation out),
+groups arrivals into micro-batches (up to ``--max_batch``, lingering at
+most ``--linger_ms`` for stragglers), pads each group to an AOT-compiled
+bucket, and writes predictions named after their inputs. ``--once``
+processes the directory's current contents and exits — the CI smoke mode.
+
+Request semantics per preset family (same as eval — SURVEY Q10): with a
+compression net the request image is the TARGET (G runs from its
+quantized compressed form); plain pix2pix presets treat it as the INPUT.
+
+Engine policies (params-only restore, buckets, bf16/frozen-int8 dtype,
+TP mesh, persistent compilation cache) are shared with cli/infer.py —
+see docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="p2p_tpu serving frontend")
+    p.add_argument("--preset", type=str, default="reference")
+    p.add_argument("--name", type=str, default=None,
+                   help="training name (checkpoint subdir; default preset)")
+    p.add_argument("--dataset", type=str, default=None)
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step to serve (default: latest)")
+    p.add_argument("--workdir", type=str, default=".")
+    p.add_argument("--input_dir", type=str, required=True,
+                   help="request directory: image files dropped here are "
+                        "served in arrival order")
+    p.add_argument("--out", type=str, default=None,
+                   help="prediction dir (default <input_dir>_out)")
+    p.add_argument("--image_size", type=int, default=None)
+    p.add_argument("--ngf", type=int, default=None)
+    p.add_argument("--n_blocks", type=int, default=None)
+    p.add_argument("--once", action="store_true",
+                   help="serve the directory's current contents, drain, "
+                        "exit (CI smoke mode)")
+    p.add_argument("--max_requests", type=int, default=None,
+                   help="exit after this many served requests (watch mode)")
+    p.add_argument("--max_batch", type=int, default=16,
+                   help="micro-batch cap (also the largest default bucket)")
+    p.add_argument("--linger_ms", type=float, default=50.0,
+                   help="max wait for stragglers before dispatching a "
+                        "partial micro-batch")
+    p.add_argument("--poll_ms", type=float, default=200.0,
+                   help="directory scan cadence in watch mode")
+    p.add_argument("--buckets", type=str, default=None,
+                   help="comma-separated batch buckets (default: powers of "
+                        "two up to --max_batch)")
+    p.add_argument("--dtype", type=str, default="bf16",
+                   choices=["bf16", "f32"])
+    p.add_argument("--mesh", type=str, default=None,
+                   help="serving mesh 'data,spatial,time[,model]'")
+    p.add_argument("--tp_min_ch", type=int, default=None)
+    p.add_argument("--io_threads", type=int, default=4)
+    p.add_argument("--compilation_cache", type=str, default=None,
+                   metavar="DIR")
+    return p
+
+
+def default_buckets(max_batch: int):
+    """1, 2, 4, ... up to (and including) max_batch — a request group of
+    any size <= max_batch pads to at most 2× its images."""
+    b, out = 1, []
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(sorted(set(out)))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import dataclasses
+
+    from p2p_tpu.cli import apply_overrides as over
+    from p2p_tpu.cli.infer import _parse_mesh
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.generate import is_image_file
+    from p2p_tpu.data.pipeline import load_image
+    from p2p_tpu.serve import engine_from_checkpoint
+
+    cfg = get_preset(args.preset)
+    if cfg.data.n_frames > 1:
+        print("serve covers image presets; use cli/infer.py for video",
+              file=sys.stderr)
+        return 2
+    data = over(cfg.data, dataset=args.dataset, image_size=args.image_size)
+    model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks)
+    cfg = dataclasses.replace(cfg, data=data, model=model,
+                              name=args.name or cfg.name)
+
+    h, w = cfg.image_hw
+    as_uint8 = cfg.data.uint8_pipeline
+
+    def decode(path):
+        # eval semantics: the request image drives whichever slot the
+        # preset reads (target for compression-net presets, input
+        # otherwise); the engine's batch spec names the keys it compiled
+        return load_image(path, h, w, as_uint8=as_uint8)
+
+    buckets = ([int(b) for b in args.buckets.split(",")] if args.buckets
+               else default_buckets(args.max_batch))
+    ckpt_dir = os.path.join(
+        args.workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
+    )
+    sample = np.zeros((1, h, w, cfg.model.input_nc),
+                      np.uint8 if as_uint8 else np.float32)
+    sample_batch = {"input": sample, "target": sample}
+    try:
+        engine, step = engine_from_checkpoint(
+            cfg, ckpt_dir, sample_batch, step=args.step,
+            buckets=buckets, dtype=args.dtype,
+            mesh=_parse_mesh(args.mesh), tp_min_ch=args.tp_min_ch,
+            with_metrics=False,  # requests carry no ground truth
+            compilation_cache_dir=args.compilation_cache,
+            io_workers=args.io_threads,
+        )
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    t0 = time.perf_counter()
+    engine.warmup()
+    print(f"serving checkpoint step {step}: {len(engine.buckets)} bucket "
+          f"programs compiled in {time.perf_counter() - t0:.2f}s "
+          f"(buckets {list(engine.buckets)})", flush=True)
+
+    out_dir = args.out or args.input_dir.rstrip("/") + "_out"
+    os.makedirs(out_dir, exist_ok=True)
+    from p2p_tpu.serve import AsyncImageWriter
+
+    writer = AsyncImageWriter(args.io_threads)
+    served = 0
+    keys = list(engine.batch_keys)
+    # requests queue as NAMES; decode happens per micro-batch at dispatch
+    # time (a 10k-file backlog must not be decoded into host RAM — or
+    # delay the first response — before the first batch ships)
+    attempts: dict = {}
+    retry_at: dict = {}          # name → monotonic time it may retry
+    MAX_ATTEMPTS = 3
+    RETRY_DELAY = 1.0            # seconds between attempts: a file still
+    #                              being copied in gets a ~3 s grace window
+
+    def dispatch(group_names):
+        """One micro-batch of request names: decode → engine → writer.
+        A file that fails to decode (e.g. still being copied in) is
+        scheduled for retry RETRY_DELAY later, up to MAX_ATTEMPTS, then
+        dropped with a warning — one bad request must never kill the
+        server."""
+        nonlocal served
+        group = []
+        for name in group_names:
+            try:
+                group.append((name, decode(os.path.join(args.input_dir,
+                                                        name))))
+            except Exception as e:
+                attempts[name] = attempts.get(name, 0) + 1
+                if attempts[name] < MAX_ATTEMPTS:
+                    retry_at[name] = time.monotonic() + RETRY_DELAY
+                else:
+                    print(f"WARNING: dropping request {name!r} after "
+                          f"{attempts[name]} failed decodes: {e}",
+                          file=sys.stderr, flush=True)
+        if not group:
+            return
+        stack = np.stack([img for _, img in group])
+        batch = {k: stack for k in keys}
+        pred, _, n_real = engine.infer_batch(batch)
+        paths = [os.path.join(out_dir,
+                              os.path.splitext(name)[0] + ".png")
+                 for name, _ in group]
+        writer.submit_batch(pred, paths)
+        served += len(group)
+
+    def collect_retries():
+        """Requests whose retry time has come — re-enter the queue."""
+        now = time.monotonic()
+        ready = [n for n, t in retry_at.items() if t <= now]
+        for n in ready:
+            del retry_at[n]
+        return ready
+
+    # a custom --buckets list may top out below --max_batch: micro-batches
+    # are capped at whichever is smaller, so dispatch never overflows the
+    # largest compiled bucket (engine.stream would chunk; infer_batch won't)
+    group_cap = min(args.max_batch, engine.buckets[-1])
+
+    def drain_queue(queue):
+        while queue:
+            work = queue[:]
+            del queue[:]
+            for i in range(0, len(work), group_cap):
+                dispatch(work[i : i + group_cap])
+
+    seen = set()
+
+    def scan():
+        fresh = []
+        try:
+            entries = sorted(os.listdir(args.input_dir))
+        except FileNotFoundError:
+            return fresh
+        for f in entries:
+            if f in seen or not is_image_file(f):
+                continue
+            seen.add(f)
+            fresh.append(f)
+        return fresh
+
+    queue = scan()
+    if args.once:
+        drain_queue(queue)
+        while retry_at:          # wait out the retry windows, then finish
+            time.sleep(RETRY_DELAY / 2)
+            queue.extend(collect_retries())
+            drain_queue(queue)
+    else:
+        try:
+            linger_start = time.perf_counter() if queue else None
+            while args.max_requests is None or served < args.max_requests:
+                if len(queue) >= args.max_batch or (
+                    queue
+                    and linger_start is not None
+                    and (time.perf_counter() - linger_start) * 1e3
+                    >= args.linger_ms
+                ):
+                    drain_queue(queue)
+                    linger_start = None
+                time.sleep(args.poll_ms / 1e3 if not queue else
+                           args.linger_ms / 1e3)
+                fresh = scan() + collect_retries()
+                if fresh and not queue:
+                    linger_start = time.perf_counter()
+                queue.extend(fresh)
+        except KeyboardInterrupt:
+            drain_queue(queue)
+    n_written = writer.drain()
+    writer.close()
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "kind": "serve_summary", "served": served, "written": n_written,
+        "out_dir": out_dir, "buckets": list(engine.buckets),
+        "n_compiles": engine.n_compiles,
+        "encode_sec": round(writer.encode_sec, 4),
+        "wall_sec": round(wall, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="keep the dead conv biases in front of norm "
                         "layers (round-2 checkpoint layout; see "
                         "ModelConfig.legacy_layout)")
+    p.add_argument("--compilation_cache", type=str, default=None,
+                   metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(core/cache.py): restarted runs reload compiled "
+                        "programs from disk instead of recompiling; "
+                        "hits/misses are counted through the obs retrace "
+                        "watchdog")
     # --- telemetry / debug knobs (p2p_tpu.obs) ----------------------------
     p.add_argument("--check_finite", action="store_true", default=None,
                    help="host-side non-finite guard on the step metrics "
@@ -217,7 +224,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  epoch_save=args.epochsave, seed=args.seed,
                  eval_fid=args.eval_fid, scan_steps=args.scan_steps,
                  pool_size=args.pool_size, save_masks=args.save_masks,
-                 log_every=args.log_every)
+                 log_every=args.log_every,
+                 compilation_cache_dir=args.compilation_cache)
     debug = over(cfg.debug, check_finite=args.check_finite,
                  nan_sentinel=args.nan_sentinel, grad_norms=args.grad_norms)
     par = over(par, tp_min_ch=args.tp_min_ch)

@@ -1,0 +1,59 @@
+"""Persistent XLA compilation cache — cold-start pays compile ONCE ever.
+
+Both the serving engine (p2p_tpu.serve: AOT bucket warmup) and the trainer
+(cfg.train.compilation_cache_dir / --compilation_cache) route through
+:func:`enable_compilation_cache`: jitted programs whose HLO+flags match a
+prior run's are loaded from the on-disk cache instead of recompiled — a
+pix2pixHD-scale XLA compile is minute-scale, so warm cold-starts matter for
+rolling serving restarts and preemption-heavy training fleets alike.
+
+Hit/miss visibility: jax.monitoring emits ``/jax/compilation_cache/
+cache_hits`` / ``cache_misses`` events; the obs RetraceWatchdog counts them
+(``persistent_cache_hits``/``persistent_cache_misses`` registry counters),
+so a fleet that silently stopped hitting its cache shows up in metrics.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_compilation_cache(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (created if missing) and drop the min-compile-time/min-entry-size
+    gates so every program is eligible — the serving buckets include
+    sub-second toy compiles in tests, and on TPU the big programs clear
+    any threshold anyway. Idempotent; returns the active dir. Call BEFORE
+    the first jit compile you want cached."""
+    global _enabled_dir
+    cache_dir = os.path.abspath(cache_dir)
+    if _enabled_dir == cache_dir:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # jax latches cache-disabled at the FIRST backend compile of the
+        # process (compilation_cache._cache_checked); any import-time jit
+        # (dataset probes, shims) would otherwise leave the cache silently
+        # inert for the whole run — reset the latch so the next compile
+        # re-evaluates with the directory set.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass  # private API moved: cache still works when set early enough
+    _enabled_dir = cache_dir
+    return cache_dir
+
+
+def compilation_cache_dir() -> Optional[str]:
+    """The directory enabled via :func:`enable_compilation_cache` (None if
+    the cache was never enabled by this process)."""
+    return _enabled_dir

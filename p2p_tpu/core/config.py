@@ -243,6 +243,11 @@ class TrainConfig:
     # enables a DEVICE-side ring buffer in TrainState (utils.pool.
     # device_pool_query) holding (real_a ‖ fake_b) pairs.
     pool_size: int = 0
+    # Persistent XLA compilation cache directory (core/cache.py): compiled
+    # programs are reused across PROCESSES, so restarts/preemptions pay
+    # XLA compile only on the first run ever. None = off. The serving
+    # engine (p2p_tpu.serve) has its own knob with the same plumbing.
+    compilation_cache_dir: Optional[str] = None
     # jax_debug_nans: first NaN-producing primitive raises with location.
     debug_nans: bool = False
     # The reference's commented "masking" experiment (train.py:324-334):

@@ -24,15 +24,25 @@ from typing import Optional
 import jax
 
 
+_TRIVIAL = None
+
+
 def measure_rtt() -> float:
     """Round-trip cost of one trivial jitted-fetch — the per-dispatch tunnel
-    tax ``chain()`` subtracts from its fenced interval."""
+    tax ``chain()`` subtracts from its fenced interval. The probe program is
+    cached process-wide: repeated calls (the serving engine measures per
+    run) must not recompile — a fresh lambda per call would both skew the
+    first measurement and trip the retrace watchdog."""
+    global _TRIVIAL
     import jax.numpy as jnp
 
-    trivial = jax.jit(lambda v: v + 1)
-    float(trivial(jnp.ones(())))  # compile outside the measured fetch
+    if _TRIVIAL is None:
+        _TRIVIAL = jax.jit(lambda v: v + 1)
+        float(_TRIVIAL(jnp.ones(())))  # compile outside the measured fetch
+    else:
+        float(_TRIVIAL(jnp.ones(())))  # warm transfer path
     t0 = time.perf_counter()
-    float(trivial(jnp.ones(())))
+    float(_TRIVIAL(jnp.ones(())))
     return time.perf_counter() - t0
 
 
@@ -108,6 +118,13 @@ class StepTimer:
                       flush=True)
             self.elapsed += max(dt - rtt, 1e-9)
             self.intervals += steps
+
+    def credit(self, steps: int, seconds: float) -> None:
+        """Account an externally-fenced interval (e.g. the serving
+        engine's dispatch→drain window, already RTT-corrected) into the
+        shared accumulator, so its img/sec is THIS definition too."""
+        self.elapsed += max(seconds, 1e-9)
+        self.intervals += steps
 
     @property
     def images_per_sec(self) -> float:

@@ -26,24 +26,42 @@ import jax
 # Fires once per XLA backend compile (empirically present on the CPU and TPU
 # runtimes of the pinned jax; registration is version-guarded regardless).
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# Persistent-compilation-cache outcome events (jax/_src/compiler.py): one
+# per backend-compile request once a cache dir is set (core/cache.py).
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 
 class RetraceWatchdog:
-    """Count backend compiles; warn on any that happen after ``arm()``."""
+    """Count backend compiles; warn on any that happen after ``arm()``.
+
+    Also counts persistent-compilation-cache hits/misses (``cache_hits`` /
+    ``cache_misses`` attributes + ``persistent_cache_hits``/``_misses``
+    registry counters) when the cache is enabled — a fleet that silently
+    stopped hitting its cache is a cold-start regression the metrics
+    stream should show."""
 
     def __init__(self, registry=None, logger=None):
         self.registry = registry
         self.logger = logger            # optional MetricsLogger for records
         self.compiles = 0               # total since construction
         self.unexpected = 0             # compiles seen while armed
+        self.cache_hits = 0             # persistent-cache loads (no compile)
+        self.cache_misses = 0           # persistent-cache misses (compiled)
         self.armed = False
         self._registered = False
+        self._event_registered = False
         try:
             from jax._src import monitoring as _mon
 
             self._mon = _mon
             _mon.register_event_duration_secs_listener(self._on_event)
             self._registered = True
+            try:
+                _mon.register_event_listener(self._on_plain_event)
+                self._event_registered = True
+            except Exception:
+                pass
         except Exception:               # jax moved the private API: degrade
             self._mon = None
 
@@ -72,6 +90,18 @@ class RetraceWatchdog:
                   f"#{self.unexpected} ({duration:.2f}s) — check for "
                   "shape/dtype wobble in the input pipeline", flush=True)
 
+    def _on_plain_event(self, event: str, **kw) -> None:
+        """Counter-style monitoring events (no duration): the persistent
+        compilation cache's hit/miss stream."""
+        if event == _CACHE_HIT_EVENT:
+            self.cache_hits += 1
+            if self.registry is not None:
+                self.registry.counter("persistent_cache_hits").inc()
+        elif event == _CACHE_MISS_EVENT:
+            self.cache_misses += 1
+            if self.registry is not None:
+                self.registry.counter("persistent_cache_misses").inc()
+
     def arm(self) -> None:
         """Call once expected warmup compiles are done; later compiles are
         flagged as unexpected."""
@@ -88,6 +118,13 @@ class RetraceWatchdog:
             except Exception:
                 pass
             self._registered = False
+        if self._event_registered and self._mon is not None:
+            try:
+                self._mon._unregister_event_listener_by_callback(
+                    self._on_plain_event)
+            except Exception:
+                pass
+            self._event_registered = False
 
 
 class MemoryWatchdog:

@@ -1,0 +1,33 @@
+"""High-throughput inference engine (the serving half of the north star).
+
+- :class:`.engine.InferenceEngine` — AOT-compiled, bucket-batched generator
+  serving with params-only restore, pipelined host I/O, bf16 / frozen-int8
+  dtype policies and optional tensor-parallel sharding;
+- :func:`.engine.engine_from_checkpoint` — template + subtree restore +
+  engine in one call (the cli/infer.py and cli/serve.py construction path);
+- :mod:`.io` — bucket padding/chunking and the threaded image writer.
+
+See docs/SERVING.md.
+"""
+
+from p2p_tpu.serve.engine import (
+    InferenceEngine,
+    ServeStats,
+    engine_from_checkpoint,
+)
+from p2p_tpu.serve.io import (
+    AsyncImageWriter,
+    chunk_batch,
+    pad_batch,
+    pick_bucket,
+)
+
+__all__ = [
+    "AsyncImageWriter",
+    "InferenceEngine",
+    "ServeStats",
+    "chunk_batch",
+    "engine_from_checkpoint",
+    "pad_batch",
+    "pick_bucket",
+]

@@ -1,0 +1,155 @@
+"""Serving host I/O: shape bucketing and pipelined (threaded) image output.
+
+The seed ``cli/infer.py`` had two host-side serialization points this
+module removes:
+
+- **tail-batch recompiles** — ``drop_remainder=False`` fed the final
+  partial batch at its own shape, recompiling the whole program for one
+  batch. :func:`pick_bucket` + :func:`pad_batch` round every request up to
+  one of a small set of pre-compiled batch buckets (edge-repeat padding;
+  per-image outputs/metrics are sliced back to the real rows, so padding
+  is unobservable — pinned by tests/test_serve.py);
+- **synchronous PNG encodes** — each ``save_img`` blocked the dispatch
+  loop on a PIL encode. :class:`AsyncImageWriter` moves device→host
+  fetch + encode into a thread pool, so encoding overlaps device compute
+  (the fetch releases the GIL; the breakdown numbers in
+  ``InferenceEngine.run`` make the overlap measurable).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2p_tpu.utils.images import save_img
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (buckets must be sorted ascending; callers
+    chunk anything larger than the biggest bucket first)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"batch of {n} exceeds largest bucket {buckets[-1]}; "
+                     "chunk with chunk_batch first")
+
+
+def pad_batch(batch: Dict[str, np.ndarray],
+              bucket_bs: int) -> Tuple[Dict[str, np.ndarray], int]:
+    """Pad a host batch's leading dim up to ``bucket_bs`` by repeating the
+    last row (benign values for any norm family; eval-mode BatchNorm uses
+    running stats so padded rows cannot perturb real ones). Returns
+    ``(padded, n_real)``."""
+    n = next(iter(batch.values())).shape[0]
+    if n == bucket_bs:
+        return batch, n
+    if n > bucket_bs:
+        raise ValueError(f"batch {n} larger than bucket {bucket_bs}")
+    pad = bucket_bs - n
+    return (
+        {k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+         for k, v in batch.items()},
+        n,
+    )
+
+
+def chunk_batch(batch: Dict[str, np.ndarray], max_bs: int):
+    """Split an oversize host batch into <= max_bs chunks (the serving
+    frontend can receive arbitrarily large request groups)."""
+    n = next(iter(batch.values())).shape[0]
+    for i in range(0, n, max_bs):
+        yield {k: v[i : i + max_bs] for k, v in batch.items()}
+
+
+class AsyncImageWriter:
+    """Thread-pooled device→host fetch + PNG encode.
+
+    ``submit_batch(pred, paths)`` enqueues one prediction batch: a worker
+    thread performs ONE ``np.asarray`` (the D2H fetch — blocking there
+    instead of on the dispatch thread is the whole point) and the PIL
+    encodes. ``drain()``
+    waits for everything and surfaces the first error. ``encode_sec``
+    accumulates per-image worker time, so callers can report how much
+    encode work overlapped device compute.
+
+    Backpressure: at most ``max_pending`` batches may be queued; a further
+    ``submit_batch`` blocks on the oldest one. Every queued task pins its
+    device prediction buffers until a worker fetches them — unbounded
+    queuing would grow HBM/host memory with the encode backlog on long
+    runs where the device outruns the encoders."""
+
+    def __init__(self, workers: int = 4, max_pending: Optional[int] = None):
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, workers), thread_name_prefix="p2p-serve-io")
+        self.max_pending = (max_pending if max_pending is not None
+                            else 4 * max(1, workers))
+        self._futures: List[Future] = []
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self.n_written = 0
+        self.encode_sec = 0.0
+
+    def _write_batch(self, pred: Any, paths: Sequence[str]) -> None:
+        t0 = time.perf_counter()
+        # ONE D2H fetch for the whole batch, here on the worker thread —
+        # never a per-image device slice (each distinct static index would
+        # compile its own tiny slice program mid-serve)
+        arr = np.asarray(pred, np.float32)
+        for i, path in enumerate(paths):
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            save_img(arr[i], path)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.n_written += len(paths)
+            self.encode_sec += dt
+
+    def _prune_done(self) -> None:
+        alive = []
+        for f in self._futures:
+            if f.done():
+                exc = f.exception()
+                if exc is not None and self._error is None:
+                    self._error = exc
+            else:
+                alive.append(f)
+        self._futures = alive
+
+    def submit_batch(self, pred: Any, paths: Sequence[str]) -> None:
+        """Enqueue the first ``len(paths)`` rows of a (device) prediction
+        batch; padding rows beyond that are never fetched into files.
+        Blocks (backpressure) once ``max_pending`` batches are in flight."""
+        self._prune_done()
+        while len(self._futures) >= self.max_pending:
+            self._futures[0].result()   # throttle on the oldest batch
+            self._prune_done()
+        self._futures.append(
+            self._pool.submit(self._write_batch, pred, list(paths)))
+
+    def drain(self) -> int:
+        """Block until every submitted image is on disk; re-raise the first
+        worker error (including from already-pruned batches); returns the
+        number written."""
+        for f in self._futures:
+            f.result()
+        self._futures.clear()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        return self.n_written
+
+    def close(self) -> None:
+        self.drain()
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -26,6 +26,22 @@ import orbax.checkpoint as ocp
 from p2p_tpu.train.state import TrainState
 
 
+def _abstract(leaf):
+    return ocp.utils.to_shape_dtype_struct(leaf)
+
+
+def _restore_arg(abstract_leaf):
+    """ArrayRestoreArgs carrying the template's dtype (Orbax casts, which
+    is what full restore does too) and sharding when the template names
+    one — the TP serving path restores shards directly into place."""
+    sharding = getattr(abstract_leaf, "sharding", None)
+    return ocp.ArrayRestoreArgs(
+        restore_type=jax.Array,
+        dtype=abstract_leaf.dtype,
+        sharding=sharding,
+    )
+
+
 class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
 
@@ -52,6 +68,69 @@ class CheckpointManager:
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           state_template)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def restore_subtree(self, template: Any, step: Optional[int] = None):
+        """Restore ONLY the subtree(s) named by ``template`` from a full
+        checkpoint — the params-only serving restore.
+
+        ``template`` is any pytree whose top-level structure is a sub-dict
+        of the saved TrainState's (e.g. an :class:`~p2p_tpu.train.state.
+        InferState`): leaves present in the template are read from disk
+        (cast to the template dtype, placed on the template sharding);
+        everything absent — discriminator, optimizer moments, pool — is
+        never materialized, host or device. Pinned bitwise-equal to
+        full-restore-then-slice, and to a fraction of the restore
+        footprint, by tests/test_serve.py.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        # The manager's own handler registry is StandardSave/Restore-only,
+        # so partial restore goes through a PyTreeCheckpointer aimed at the
+        # step's item directory (StandardSave writes item name 'default').
+        item_dir = os.path.join(str(self._mgr.directory), str(step),
+                                "default")
+        if not os.path.isdir(item_dir):
+            raise FileNotFoundError(f"no checkpoint item at {item_dir}")
+        # struct.PyTreeNode templates restore through their field-name dict
+        # (the structure StandardSave recorded); None/empty fields (no
+        # compression net, no quant scales) hold no arrays and must not
+        # reach the reader — they keep their template value.
+        import dataclasses
+
+        is_node = dataclasses.is_dataclass(template)
+        fields = (
+            {f.name: getattr(template, f.name)
+             for f in dataclasses.fields(template)}
+            if is_node else dict(template)
+        )
+        want = {k: v for k, v in fields.items()
+                if jax.tree_util.tree_leaves(v)}
+        abstract = jax.tree_util.tree_map(_abstract, want)
+        restore_args = jax.tree_util.tree_map(_restore_arg, abstract)
+        import logging
+
+        absl_logger = logging.getLogger("absl")
+        prev_level = absl_logger.level
+        # orbax deprecation-warns (via absl) about the transformations API
+        # on every partial restore; one serving process may restore many
+        # times — silence just this call.
+        absl_logger.setLevel(logging.ERROR)
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                restored = ckptr.restore(
+                    item_dir,
+                    args=ocp.args.PyTreeRestore(
+                        item=abstract,
+                        transforms={},  # keep template entries, drop rest
+                        restore_args=restore_args,
+                    ),
+                )
+        finally:
+            absl_logger.setLevel(prev_level)
+        out = dict(fields)
+        out.update({k: restored[k] for k in want})
+        return type(template)(**out) if is_node else out
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -226,6 +226,14 @@ class Trainer:
 
             enable_nan_debugging()
 
+        if cfg.train.compilation_cache_dir:
+            # before any step compiles: restarts/preemptions reload XLA
+            # programs from disk instead of recompiling (core/cache.py);
+            # hits/misses are counted by the retrace watchdog below
+            from p2p_tpu.core.cache import enable_compilation_cache
+
+            enable_compilation_cache(cfg.train.compilation_cache_dir)
+
         if cfg.train.eval_fid and jax.process_count() > 1:
             # FIDEvaluator accumulates host-side numpy features; a global
             # array's rows are only partially addressable per process.

@@ -56,6 +56,91 @@ class TrainState(struct.PyTreeNode):
     opt_s: Optional[optax.OptState] = None
 
 
+class InferState(struct.PyTreeNode):
+    """The serving-side state: ONLY what the generator eval path reads.
+
+    A full :class:`TrainState` carries the discriminator, three Adam
+    optimizers (2× params each) and the fake pool — none of which inference
+    touches. Serving restores THIS subtree straight from a full-TrainState
+    checkpoint (:meth:`p2p_tpu.train.checkpoint.CheckpointManager.
+    restore_subtree` reads only these arrays from disk), so building the
+    engine never materializes D or moments, and no --ndf/--pool_size
+    template-rebuild knobs are needed to address a checkpoint.
+    """
+
+    step: jax.Array
+    params_g: Any
+    batch_stats_g: Any
+    # compression pre-filter (None-filled when the preset has none)
+    params_c: Any = None
+    batch_stats_c: Any = None
+    # delayed-int8 stored activation scales; in eval mode the 'quant'
+    # collection is read-only, so these act as FROZEN inference scales
+    quant_g: Any = None
+
+
+def create_infer_state(
+    cfg: Config,
+    rng: jax.Array,
+    sample_batch: Dict[str, jax.Array],
+    train_dtype=None,
+) -> InferState:
+    """Generator(+compression-net)-only template — the abstract tree
+    ``restore_subtree`` restores into. Initializes ONLY G (and C when the
+    preset has one): no discriminator, no optimizer state, so the template
+    itself is ~1/5 the size of a ``create_train_state`` template and needs
+    no D hyperparameters (ndf) or pool sizing to match the checkpoint."""
+    g = define_G(cfg.model, dtype=train_dtype, remat=cfg.parallel.remat)
+    c = (define_C(cfg.model, dtype=train_dtype)
+         if cfg.model.use_compression_net else None)
+    kg, _, kc = jax.random.split(rng, 3)
+    from p2p_tpu.utils.images import ingest
+
+    x = ingest(jnp.asarray(sample_batch["input"]))
+    vg = init_variables(g, kg, x, cfg.model.init_type, cfg.model.init_gain,
+                        train=False)
+    params_c = batch_stats_c = None
+    if c is not None:
+        vc = init_variables(c, kc, x, cfg.model.init_type, cfg.model.init_gain,
+                            train=False)
+        params_c = vc["params"]
+        batch_stats_c = vc.get("batch_stats", {})
+    delayed = cfg.model.int8_delayed
+    return InferState(
+        step=jnp.zeros((), jnp.int32),
+        params_g=vg["params"],
+        batch_stats_g=vg.get("batch_stats", {}),
+        params_c=params_c,
+        batch_stats_c=batch_stats_c,
+        quant_g=vg.get("quant", {}) if delayed else None,
+    )
+
+
+def infer_state_from_train(state: "TrainState") -> InferState:
+    """Slice the serving subtree out of a live/full TrainState (the
+    reference point ``restore_subtree`` is pinned bitwise-equal to)."""
+    return InferState(
+        step=state.step,
+        params_g=state.params_g,
+        batch_stats_g=state.batch_stats_g,
+        params_c=state.params_c,
+        batch_stats_c=state.batch_stats_c,
+        quant_g=state.quant_g,
+    )
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total materialized array bytes across a pytree — the host/device
+    memory pin for params-only vs full-state restore."""
+    import math
+
+    return sum(
+        math.prod(getattr(leaf, "shape", ()) or (1,))
+        * jnp.dtype(getattr(leaf, "dtype", jnp.float32)).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
 def _zero_nonfinite() -> optax.GradientTransformation:
     """Replace non-finite (inf/NaN) gradient leaves' bad entries with 0,
     so a single blown-up sample is dropped rather than poisoning the

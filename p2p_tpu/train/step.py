@@ -790,24 +790,33 @@ def build_multi_train_step(
     return jax.jit(multi_step, donate_argnums=0)
 
 
-def build_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
-    """``eval_step(state, batch) -> (prediction, metrics)``.
+def make_infer_forward(cfg: Config, train_dtype=None,
+                       with_metrics: bool = True):
+    """The ONE generator inference definition, shared by the trainer's
+    eval step and the serving engine (p2p_tpu.serve).
 
-    Reference eval (train.py:450-502) drives G from the compressed TARGET
-    (the stored input image is unused — Q10); without a compression net the
-    generator consumes the stored input, standard pix2pix eval. Metrics are
-    computed in the CORRECT pixel space (Q8 fixed; bug-compatible mode
-    available in p2p_tpu.losses.metrics directly).
+    Returns ``fwd(state, batch) -> (pred, metrics)`` where ``state`` is
+    anything exposing the generator-side fields (a full :class:`TrainState`
+    or the serving :class:`~p2p_tpu.train.state.InferState`). Reference
+    eval semantics (train.py:450-502): with a compression net G is driven
+    from the quantized compressed TARGET (the stored input is unused —
+    Q10); otherwise from the stored input, standard pix2pix eval. In eval
+    mode the delayed-int8 'quant' collection is read-only, so restored
+    activation scales act as FROZEN inference scales.
+
+    ``with_metrics=False`` (the pure serving path, no targets on hand)
+    skips the PSNR/SSIM graph and returns ``metrics = {}``.
     """
-    g, d, c = build_models(cfg, train_dtype)
+    g, _, c = build_models(cfg, train_dtype)
     bits = cfg.model.quant_bits
 
-    def step(state: TrainState, batch: Dict[str, jax.Array]):
+    def fwd(state, batch: Dict[str, jax.Array]):
         real_a = ingest(batch["input"], train_dtype)
-        real_b = ingest(batch["target"], train_dtype)
         if cfg.model.use_compression_net:
+            real_b = ingest(batch["target"], train_dtype)
             raw = c.apply(
-                {"params": state.params_c, "batch_stats": state.batch_stats_c},
+                {"params": state.params_c,
+                 "batch_stats": state.batch_stats_c},
                 real_b, False,
             )
             g_in = quantize(raw, bits)
@@ -818,15 +827,26 @@ def build_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
         if cfg.model.int8_delayed:
             g_vars["quant"] = state.quant_g
         pred = g.apply(g_vars, g_in, False)
-        # Per-image vectors so the driver can report the reference's
-        # mean AND max over individual test images (train.py:498-502)
-        # even at test_batch_size > 1.
-        metrics = {
-            "psnr": psnr(real_b, pred, per_image=True),
-            "ssim": ssim(real_b, pred, per_image=True),
-        }
+        metrics = {}
+        if with_metrics:
+            real_b = ingest(batch["target"], train_dtype)
+            # Per-image vectors so the driver can report the reference's
+            # mean AND max over individual test images (train.py:498-502)
+            # even at test_batch_size > 1 — and so the serving engine can
+            # mask bucket-padding rows off by slicing.
+            metrics = {
+                "psnr": psnr(real_b, pred, per_image=True),
+                "ssim": ssim(real_b, pred, per_image=True),
+            }
         return pred, metrics
 
+    return fwd
+
+
+def build_eval_step(cfg: Config, train_dtype=None, jit: bool = True):
+    """``eval_step(state, batch) -> (prediction, metrics)`` — the trainer's
+    per-epoch eval, a jitted :func:`make_infer_forward`."""
+    step = make_infer_forward(cfg, train_dtype)
     if jit:
         step = jax.jit(step)
     return step

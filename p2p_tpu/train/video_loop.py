@@ -93,6 +93,10 @@ class VideoTrainer:
             cfg.data.test_batch_size, self.mesh)
 
         dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
+        if cfg.train.compilation_cache_dir:
+            from p2p_tpu.core.cache import enable_compilation_cache
+
+            enable_compilation_cache(cfg.train.compilation_cache_dir)
         self.vgg_params = (
             load_vgg19_params() if cfg.loss.lambda_vgg > 0 else None
         )

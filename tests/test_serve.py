@@ -1,0 +1,419 @@
+"""Serving engine (p2p_tpu.serve) + params-only restore.
+
+Pins the four serving contracts of docs/SERVING.md:
+- restore_subtree == full-restore-then-slice, bitwise, at a fraction of
+  the materialized bytes (the host-memory pin);
+- exactly ONE XLA compile per batch bucket, and ZERO recompiles while
+  serving (tail batches pad to a bucket instead of retracing);
+- bucket padding is unobservable: per-image PSNR/SSIM and saved files
+  match the unpadded path;
+- dtype/TP policies: bf16 within a parity band of f32, frozen-scale
+  int8 serving identical to the trainer's eval step, TP-sharded
+  inference == single-device.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_tpu.core.config import (
+    Config,
+    DataConfig,
+    LossConfig,
+    ModelConfig,
+    OptimConfig,
+    ParallelConfig,
+    TrainConfig,
+    get_preset,
+)
+from p2p_tpu.core.mesh import MeshSpec
+from p2p_tpu.data.synthetic import make_synthetic_dataset, synthetic_batch
+from p2p_tpu.serve import InferenceEngine, pad_batch, pick_bucket
+from p2p_tpu.train.checkpoint import CheckpointManager
+from p2p_tpu.train.state import (
+    create_infer_state,
+    create_train_state,
+    infer_state_from_train,
+    tree_bytes,
+)
+from p2p_tpu.train.step import build_eval_step, build_train_step
+
+
+def tiny_config(**model_kw):
+    """Reference-style tiny config (compression net + multiscale D)."""
+    return Config(
+        name="tiny",
+        model=ModelConfig(ngf=8, n_blocks=2, ndf=8, num_D=2, **model_kw),
+        loss=LossConfig(lambda_feat=10.0, lambda_vgg=0.0, lambda_tv=1.0),
+        optim=OptimConfig(niter=2, niter_decay=2),
+        data=DataConfig(batch_size=2, image_size=32, test_batch_size=2),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+        train=TrainConfig(seed=0, mixed_precision=False),
+    )
+
+
+def unet_config(**model_kw):
+    """facades-style tiny config (plain pix2pix U-Net, no C net)."""
+    kw = dict(generator="unet", ngf=8, ndf=8, num_D=1, n_layers_D=2,
+              use_spectral_norm=False, use_compression_net=False)
+    kw.update(model_kw)
+    return Config(
+        name="tinyunet",
+        model=ModelConfig(**kw),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        optim=OptimConfig(niter=2, niter_decay=2),
+        data=DataConfig(batch_size=2, image_size=32, test_batch_size=2),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+        train=TrainConfig(seed=0, mixed_precision=False),
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return {k: jnp.asarray(v)
+            for k, v in synthetic_batch(2, 32, dtype="uint8").items()}
+
+
+@pytest.fixture(scope="module")
+def trained_ckpt(tmp_path_factory, batch):
+    """One real train step on the reference-style tiny config, saved as a
+    full TrainState checkpoint — the restore target of every test here."""
+    cfg = tiny_config()
+    state = create_train_state(cfg, jax.random.key(0), batch, 1)
+    state, _ = build_train_step(cfg, None, 1, None)(state, dict(batch))
+    d = str(tmp_path_factory.mktemp("serve_ckpt"))
+    mgr = CheckpointManager(d)
+    mgr.save(1, state, wait=True)
+    mgr.close()
+    return cfg, state, d
+
+
+# ------------------------------------------------------- params-only restore
+def test_restore_subtree_bitwise_equals_full_restore_slice(trained_ckpt,
+                                                           batch):
+    cfg, state, d = trained_ckpt
+    mgr = CheckpointManager(d)
+    template = create_infer_state(cfg, jax.random.key(7), batch)
+    restored = mgr.restore_subtree(template)
+    ref = infer_state_from_train(state)
+    ra, rb = (jax.tree_util.tree_leaves_with_path(ref),
+              jax.tree_util.tree_leaves_with_path(restored))
+    assert len(ra) == len(rb) > 0
+    for (pa, a), (pb, b) in zip(ra, rb):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_restore_subtree_materializes_fraction_of_full_state(trained_ckpt,
+                                                             batch):
+    """The host/device-memory pin: the params-only restore materializes a
+    strict fraction of the full-state restore (no D, no Adam moments)."""
+    cfg, state, d = trained_ckpt
+    mgr = CheckpointManager(d)
+    template = create_infer_state(cfg, jax.random.key(7), batch)
+    restored = mgr.restore_subtree(template)
+    full = mgr.restore(
+        create_train_state(cfg, jax.random.key(8), batch, 1))
+    assert tree_bytes(restored) < 0.5 * tree_bytes(full)
+    # the template itself (what must exist BEFORE restoring) is small too
+    assert tree_bytes(template) < 0.5 * tree_bytes(state)
+    mgr.close()
+
+
+def test_restore_subtree_missing_checkpoint_raises(tmp_path, batch):
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore_subtree(
+            create_infer_state(tiny_config(), jax.random.key(0), batch))
+    mgr.close()
+
+
+# ------------------------------------------------ buckets / compiles / masks
+def test_exactly_one_compile_per_bucket_and_none_while_serving(tmp_path,
+                                                               batch):
+    from p2p_tpu.obs import RetraceWatchdog, measure_rtt
+
+    cfg = unet_config()
+    state = infer_state_from_train(
+        create_train_state(cfg, jax.random.key(0), batch, 1))
+    engine = InferenceEngine(cfg, state, buckets=(1, 2), dtype="f32")
+    engine.warmup()
+    assert engine.n_compiles == 2           # exactly one per bucket
+    measure_rtt()                           # warm the probe program too
+
+    watchdog = RetraceWatchdog()
+    watchdog.arm()
+    try:
+        def batches():
+            for n in (2, 1, 2, 1):          # tails route to bucket 1
+                yield {k: np.asarray(v)[:n] for k, v in batch.items()}
+
+        stats, metrics = engine.run(
+            batches(), out_dir=str(tmp_path / "out"), collect_metrics=True)
+    finally:
+        watchdog.close()
+    assert stats.n_images == 6
+    assert engine.n_compiles == 2           # serving never recompiled...
+    assert watchdog.unexpected == 0         # ...and neither did anything else
+    assert len(os.listdir(tmp_path / "out")) == 6
+    assert len(metrics["psnr"]) == 6
+
+
+def test_bucket_padding_is_unobservable(trained_ckpt, tmp_path):
+    """5 images at bs=2 (one padded tail) produce the SAME per-image
+    metrics and predictions as the unpadded per-image eval path."""
+    cfg, state, d = trained_ckpt
+    istate = infer_state_from_train(state)
+    imgs = synthetic_batch(5, 32, seed=3, dtype="uint8")
+
+    engine = InferenceEngine(cfg, istate, buckets=(2,), dtype="f32")
+
+    def batches():
+        for i in range(0, 5, 2):
+            yield {k: v[i : i + 2] for k, v in imgs.items()}
+
+    stats, metrics = engine.run(
+        batches(), out_dir=str(tmp_path / "p"), collect_metrics=True)
+    assert stats.n_images == 5
+    assert sorted(os.listdir(tmp_path / "p")) == [
+        f"{i}.png" for i in range(5)]
+
+    # reference: the trainer's eval step, one image at a time (no padding)
+    eval_step = build_eval_step(cfg, None)
+    ref_psnr, ref_ssim = [], []
+    for i in range(5):
+        single = {k: v[i : i + 1] for k, v in imgs.items()}
+        _, m = eval_step(istate, single)
+        ref_psnr.append(float(m["psnr"][0]))
+        ref_ssim.append(float(m["ssim"][0]))
+    np.testing.assert_allclose(metrics["psnr"], ref_psnr, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(metrics["ssim"], ref_ssim, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_pick_bucket_and_pad_batch():
+    assert pick_bucket(3, (1, 4, 8)) == 4
+    assert pick_bucket(8, (1, 4, 8)) == 8
+    with pytest.raises(ValueError):
+        pick_bucket(9, (1, 4, 8))
+    b = {"input": np.arange(6, dtype=np.float32).reshape(3, 2)}
+    padded, n = pad_batch(b, 4)
+    assert n == 3 and padded["input"].shape == (4, 2)
+    np.testing.assert_array_equal(padded["input"][3], b["input"][2])
+
+
+def test_oversize_batch_chunks_to_buckets(batch):
+    cfg = unet_config()
+    state = infer_state_from_train(
+        create_train_state(cfg, jax.random.key(0), batch, 1))
+    engine = InferenceEngine(cfg, state, buckets=(2,), dtype="f32")
+    big = synthetic_batch(5, 32, seed=9, dtype="uint8")
+    outs = list(engine.stream([big]))
+    assert [n for _, _, n in outs] == [2, 2, 1]
+    assert engine.n_compiles == 1
+
+
+# ------------------------------------------------------------ dtype policies
+def test_bf16_engine_within_parity_band_of_f32(trained_ckpt):
+    from p2p_tpu.losses import psnr
+
+    cfg, state, _ = trained_ckpt
+    istate = infer_state_from_train(state)
+    imgs = synthetic_batch(2, 32, seed=5, dtype="uint8")
+    p32, _, _ = InferenceEngine(cfg, istate, dtype="f32").infer_batch(imgs)
+    p16, _, _ = InferenceEngine(cfg, istate, dtype="bf16").infer_batch(imgs)
+    band = psnr(jnp.asarray(p32, jnp.float32),
+                jnp.asarray(p16, jnp.float32), per_image=True)
+    # bf16 compute (f32 params) stays within a tight band of the f32 path
+    assert float(jnp.min(band)) > 25.0, np.asarray(band)
+
+
+def test_int8_frozen_scale_engine_matches_eval_step(batch):
+    """Delayed-int8 serving: the restored 'quant' amax scales are read
+    FROZEN in eval mode — engine output must equal the trainer's own eval
+    step on the full state, bitwise."""
+    cfg = unet_config(int8=True, int8_generator=True, int8_delayed=True)
+    state = create_train_state(cfg, jax.random.key(0), batch, 1)
+    state, _ = build_train_step(cfg, None, 1, None)(state, dict(batch))
+    assert jax.tree_util.tree_leaves(state.quant_g)  # scales exist + trained
+    istate = infer_state_from_train(state)
+    imgs = synthetic_batch(2, 32, seed=6, dtype="uint8")
+    pred_engine, _, _ = InferenceEngine(
+        cfg, istate, dtype="f32").infer_batch(imgs)
+    pred_eval, _ = build_eval_step(cfg, None)(state, imgs)
+    np.testing.assert_array_equal(np.asarray(pred_engine, np.float32),
+                                  np.asarray(pred_eval, np.float32))
+
+
+# --------------------------------------------------------------- TP serving
+def test_tp_sharded_engine_matches_single_device(devices8, batch):
+    from p2p_tpu.core.mesh import make_mesh
+
+    cfg = unet_config(ngf=16)
+    state = infer_state_from_train(
+        create_train_state(cfg, jax.random.key(0), batch, 1))
+    imgs = synthetic_batch(2, 32, seed=11, dtype="uint8")
+    ref, _, _ = InferenceEngine(cfg, state, dtype="f32").infer_batch(imgs)
+
+    mesh = make_mesh(MeshSpec(data=1, model=2), devices=devices8[:2])
+    tp = InferenceEngine(cfg, state, dtype="f32", mesh=mesh, tp_min_ch=16)
+    pred, _, _ = tp.infer_batch(imgs)
+    np.testing.assert_allclose(np.asarray(pred, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-4, atol=2e-4)
+
+
+# --------------------------------------------------------- compilation cache
+def test_persistent_compilation_cache_hits_across_engines(tmp_path, batch):
+    """Second engine over the same config loads its bucket program from
+    the on-disk cache (counted by the retrace watchdog) instead of
+    recompiling — the cold-start story of docs/SERVING.md."""
+    from p2p_tpu.obs import RetraceWatchdog
+
+    cfg = unet_config()
+    state = infer_state_from_train(
+        create_train_state(cfg, jax.random.key(0), batch, 1))
+    cache = str(tmp_path / "xla_cache")
+    watchdog = RetraceWatchdog()
+    try:
+        InferenceEngine(cfg, state, dtype="f32",
+                        compilation_cache_dir=cache).warmup()
+        assert os.listdir(cache), "warmup wrote no cache entries"
+        hits_before = watchdog.cache_hits
+        InferenceEngine(cfg, state, dtype="f32",
+                        compilation_cache_dir=cache).warmup()
+        assert watchdog.cache_hits > hits_before
+    finally:
+        watchdog.close()
+
+
+# ------------------------------------------------------------ CLI round-trips
+def _save_facades_ckpt(workdir, cfg, batch):
+    state = create_train_state(cfg, jax.random.key(0), batch, 1)
+    d = os.path.join(workdir, cfg.train.checkpoint_dir, cfg.data.dataset,
+                     cfg.name)
+    mgr = CheckpointManager(d)
+    mgr.save(1, state, wait=True)
+    mgr.close()
+    return state
+
+
+def test_infer_cli_image_round_trip(tmp_path):
+    """generate → checkpoint → cli.infer through the engine path: every
+    test image gets a prediction, tail batch included, --ndf ignored."""
+    import dataclasses
+
+    from p2p_tpu.cli.infer import main as infer_main
+
+    root = make_synthetic_dataset(str(tmp_path / "ds"), 2, 5, size=16)
+    cfg = get_preset("facades")
+    cfg = dataclasses.replace(
+        cfg,
+        name="t",
+        model=dataclasses.replace(cfg.model, ngf=4),
+        data=dataclasses.replace(cfg.data, dataset="synth", image_size=16,
+                                 batch_size=2, test_batch_size=2),
+    )
+    sample = synthetic_batch(2, 16, dtype="uint8")
+    _save_facades_ckpt(str(tmp_path), cfg, sample)
+    rc = infer_main([
+        "--preset", "facades", "--dataset", "synth", "--name", "t",
+        "--image_size", "16", "--ngf", "4", "--ndf", "4",
+        "--batch_size", "2", "--data_root", root,
+        "--workdir", str(tmp_path), "--out", str(tmp_path / "pred"),
+        "--dtype", "f32", "--metrics", "--stats",
+    ])
+    assert rc == 0
+    assert len(os.listdir(tmp_path / "pred")) == 5
+
+
+def test_serve_cli_once_round_trip(tmp_path):
+    """Directory-driven serving: drop images in, --once serves them all
+    through the bucket router and writes one prediction per request."""
+    import dataclasses
+
+    from p2p_tpu.cli.serve import main as serve_main
+
+    root = make_synthetic_dataset(str(tmp_path / "ds"), 0, 3, size=16)
+    cfg = get_preset("facades")
+    cfg = dataclasses.replace(
+        cfg,
+        name="t",
+        model=dataclasses.replace(cfg.model, ngf=4),
+        data=dataclasses.replace(cfg.data, dataset="synth", image_size=16),
+    )
+    sample = synthetic_batch(1, 16, dtype="uint8")
+    _save_facades_ckpt(str(tmp_path), cfg, sample)
+    in_dir = os.path.join(root, "test", "a")
+    # a corrupt request must be dropped with a warning, never kill the
+    # server or block the valid ones
+    with open(os.path.join(in_dir, "corrupt.png"), "wb") as f:
+        f.write(b"not a png")
+    rc = serve_main([
+        "--preset", "facades", "--dataset", "synth", "--name", "t",
+        "--image_size", "16", "--ngf", "4", "--workdir", str(tmp_path),
+        "--input_dir", in_dir,
+        "--out", str(tmp_path / "served"), "--once",
+        "--max_batch", "2", "--dtype", "f32",
+    ])
+    assert rc == 0
+    assert len(os.listdir(tmp_path / "served")) == 3
+
+    # custom --buckets topping out BELOW --max_batch: micro-batches cap at
+    # the largest compiled bucket instead of overflowing it
+    rc = serve_main([
+        "--preset", "facades", "--dataset", "synth", "--name", "t",
+        "--image_size", "16", "--ngf", "4", "--workdir", str(tmp_path),
+        "--input_dir", in_dir,
+        "--out", str(tmp_path / "served2"), "--once",
+        "--max_batch", "16", "--buckets", "1,2", "--dtype", "f32",
+    ])
+    assert rc == 0
+    assert len(os.listdir(tmp_path / "served2")) == 3
+
+
+@pytest.mark.slow
+def test_infer_cli_video_round_trip(tmp_path):
+    """Video presets stay on the clip path (full-state restore) and still
+    give every frame a prediction through the same CLI."""
+    import dataclasses
+
+    from p2p_tpu.cli.infer import main as infer_main
+    from p2p_tpu.data.video import make_synthetic_video_dataset
+    from p2p_tpu.train.video_step import create_video_train_state
+
+    root = str(tmp_path / "vds")
+    make_synthetic_video_dataset(root, n_videos=1, n_frames=8, size=16)
+    cfg = get_preset("vid2vid_temporal")
+    cfg = dataclasses.replace(
+        cfg,
+        name="v",
+        model=dataclasses.replace(cfg.model, ngf=4, ndf=4),
+        data=dataclasses.replace(cfg.data, dataset="vid2vid", image_size=16,
+                                 batch_size=1, test_batch_size=1),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+    )
+    clip = synthetic_batch(cfg.data.n_frames, 16, dtype="uint8")
+    clip = {k: v[None] for k, v in clip.items()}  # (1, T, H, W, C)
+    state = create_video_train_state(cfg, jax.random.key(0), clip)
+    d = os.path.join(str(tmp_path), cfg.train.checkpoint_dir,
+                     cfg.data.dataset, cfg.name)
+    mgr = CheckpointManager(d)
+    mgr.save(1, state, wait=True)
+    mgr.close()
+    rc = infer_main([
+        "--preset", "vid2vid_temporal", "--dataset", "vid2vid",
+        "--name", "v", "--image_size", "16", "--ngf", "4",
+        "--data_root", root, "--workdir", str(tmp_path),
+        "--out", str(tmp_path / "pred"),
+    ])
+    assert rc == 0
+    assert len(os.listdir(tmp_path / "pred")) == 8  # 1 video × 8 frames
