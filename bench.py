@@ -37,6 +37,18 @@ steps, and reports (CI runs it).
 PNG output) on synthetic data, reported with the fenced breakdown
 (end-to-end img/s, device img/s, encode overlap, compiles-per-bucket).
 ``--infer --dry-run`` is its CPU-able CI plumbing row.
+
+``--chaos [SPEC]`` arms the fault-injection layer
+(p2p_tpu.resilience.chaos) for the run — default spec
+``serve_write:1.0x2`` makes the first two output writes fail (then the
+seam goes quiet), so the row measures throughput WITH the retry/recovery
+machinery firing; ``chaos_injected``/``retries`` land in the record. The
+resilience contract this mode stands guard over: injected faults at the
+wrapped seams must cost retries, never correctness — the row must still
+satisfy the bucket-compile contract and stay in band. (Probabilistic
+specs like ``serve_write:0.2`` measure sustained-fault throughput but CAN
+legitimately exhaust the 3-attempt retry budget on an unlucky streak —
+that's the give-up-eventually contract, not a bug.)
 """
 
 from __future__ import annotations
@@ -462,12 +474,32 @@ def main(argv=None) -> int:
                     help="bench the serving engine instead of the train "
                          "step: AOT bucket-batched inference + pipelined "
                          "PNG output, fenced breakdown (docs/SERVING.md)")
+    ap.add_argument("--chaos", nargs="?", const="serve_write:1.0x2",
+                    default=None, metavar="SPEC",
+                    help="arm fault injection for the run (default spec "
+                         "'serve_write:1.0x2'): the row measures "
+                         "throughput with retries firing — the resilience "
+                         "overhead number (docs/RESILIENCE.md)")
     ap.add_argument("--dry-run", action="store_true",
                     help="with --sweep/--infer: toy dims, plumbing check "
                          "only (CPU-able; no band comparison)")
     args = ap.parse_args(argv)
+    chaos_counts = None
+    if args.chaos:
+        from p2p_tpu.resilience import ChaosMonkey, install_chaos
+
+        monkey = ChaosMonkey.from_spec(args.chaos)
+        install_chaos(monkey)
+        chaos_counts = monkey.counts
     if args.infer:
-        print(json.dumps(run_infer(tiny=args.dry_run)))
+        rec = run_infer(tiny=args.dry_run)
+        if chaos_counts is not None:
+            from p2p_tpu.obs import get_registry
+
+            rec["chaos_injected"] = chaos_counts()
+            rec["retries"] = int(
+                get_registry().total("retry_attempts_total"))
+        print(json.dumps(rec))
         return 0
     if args.sweep:
         return run_sweep(dry_run=args.dry_run)

@@ -14,6 +14,16 @@ quantized compressed form); plain pix2pix presets treat it as the INPUT.
 Engine policies (params-only restore, buckets, bf16/frozen-int8 dtype,
 TP mesh, persistent compilation cache) are shared with cli/infer.py —
 see docs/SERVING.md.
+
+Hardening (p2p_tpu.resilience, docs/RESILIENCE.md): the request queue is
+BOUNDED (``--max_queue``; overflow arrivals are shed and counted), each
+request carries a deadline (``--deadline_ms``; expired requests are
+dropped at dispatch, not served late), decode failures retry with backoff
+up to ``--max_attempts`` and then the file is MOVED to a quarantine dir
+(``--quarantine_dir``, default ``<input_dir>/failed``) so one poison
+input can never wedge the server, and predictions are written atomically
+(temp + rename — serve/io.py). ``--chaos``/``P2P_CHAOS`` inject faults at
+the decode/write seams to rehearse all of the above.
 """
 
 from __future__ import annotations
@@ -67,6 +77,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--io_threads", type=int, default=4)
     p.add_argument("--compilation_cache", type=str, default=None,
                    metavar="DIR")
+    # --- resilience knobs (docs/RESILIENCE.md) ---------------------------
+    p.add_argument("--max_queue", type=int, default=512,
+                   help="request queue depth cap; overflow arrivals are "
+                        "SHED (counted, never served) — bounded memory "
+                        "and bounded worst-case latency under overload")
+    p.add_argument("--deadline_ms", type=float, default=0.0,
+                   help="per-request deadline from arrival; requests "
+                        "older than this at dispatch time are dropped "
+                        "(0 = no deadline)")
+    p.add_argument("--max_attempts", type=int, default=3,
+                   help="decode attempts per request before the file is "
+                        "moved to the quarantine dir")
+    p.add_argument("--retry_delay_ms", type=float, default=1000.0,
+                   help="base delay between decode attempts (a file still "
+                        "being copied in gets this grace window, with "
+                        "exponential backoff)")
+    p.add_argument("--quarantine_dir", type=str, default=None,
+                   help="poison inputs land here after --max_attempts "
+                        "failed decodes (default <input_dir>/failed)")
+    p.add_argument("--chaos", type=str, default=None, metavar="SPEC",
+                   help="arm fault injection, e.g. 'decode:0.3' or "
+                        "'serve_write:0.2x5' (p2p_tpu.resilience.chaos; "
+                        "P2P_CHAOS env works too)")
     return p
 
 
@@ -109,7 +142,13 @@ def main(argv=None) -> int:
     def decode(path):
         # eval semantics: the request image drives whichever slot the
         # preset reads (target for compression-net presets, input
-        # otherwise); the engine's batch spec names the keys it compiled
+        # otherwise); the engine's batch spec names the keys it compiled.
+        # The `decode` chaos seam lives HERE, not in load_image — serving
+        # has retry/quarantine around this call; training decode fails
+        # fast and must never see injected faults.
+        from p2p_tpu.resilience.chaos import chaos_point
+
+        chaos_point("decode")
         return load_image(path, h, w, as_uint8=as_uint8)
 
     buckets = ([int(b) for b in args.buckets.split(",")] if args.buckets
@@ -140,122 +179,195 @@ def main(argv=None) -> int:
 
     out_dir = args.out or args.input_dir.rstrip("/") + "_out"
     os.makedirs(out_dir, exist_ok=True)
+    from p2p_tpu.obs import get_registry
+    from p2p_tpu.resilience import (
+        BoundedRequestQueue,
+        ChaosMonkey,
+        Quarantine,
+        install_chaos,
+    )
+
+    reg = get_registry()
+    prev_chaos = None
+    if args.chaos:
+        prev_chaos = install_chaos(
+            ChaosMonkey.from_spec(args.chaos, registry=reg))
+    queue = BoundedRequestQueue(
+        max_depth=args.max_queue,
+        deadline_s=(args.deadline_ms / 1e3) if args.deadline_ms > 0 else None,
+        registry=reg,
+    )
+    quarantine = Quarantine(
+        args.quarantine_dir or os.path.join(args.input_dir, "failed"),
+        registry=reg,
+    )
     from p2p_tpu.serve import AsyncImageWriter
 
-    writer = AsyncImageWriter(args.io_threads)
+    # fail_fast=False: a poison OUTPUT path (directory squatting on the
+    # target name, dead volume) is recorded + counted, never fatal — the
+    # write-side analog of decode quarantine
+    writer = AsyncImageWriter(args.io_threads, fail_fast=False)
     served = 0
     keys = list(engine.batch_keys)
-    # requests queue as NAMES; decode happens per micro-batch at dispatch
-    # time (a 10k-file backlog must not be decoded into host RAM — or
-    # delay the first response — before the first batch ships)
-    attempts: dict = {}
-    retry_at: dict = {}          # name → monotonic time it may retry
-    MAX_ATTEMPTS = 3
-    RETRY_DELAY = 1.0            # seconds between attempts: a file still
-    #                              being copied in gets a ~3 s grace window
+    retry_delay = args.retry_delay_ms / 1e3
 
-    def dispatch(group_names):
-        """One micro-batch of request names: decode → engine → writer.
-        A file that fails to decode (e.g. still being copied in) is
-        scheduled for retry RETRY_DELAY later, up to MAX_ATTEMPTS, then
-        dropped with a warning — one bad request must never kill the
-        server."""
+    # requests queue as NAMES (BoundedRequestQueue of file names); decode
+    # happens per micro-batch at dispatch time (a 10k-file backlog must
+    # not be decoded into host RAM — or delay the first response — before
+    # the first batch ships)
+    def dispatch(group_reqs):
+        """One micro-batch of requests: decode → engine → writer.
+
+        A failed decode (file still being copied in, injected chaos, real
+        corruption) re-enters the queue with exponential backoff up to
+        --max_attempts; after that the file is MOVED to the quarantine
+        dir — capped attempts, and a permanently-corrupt input can never
+        be re-enqueued again. One bad request must never kill the server.
+        """
         nonlocal served
         group = []
-        for name in group_names:
+        for req in group_reqs:
+            path = os.path.join(args.input_dir, req.name)
             try:
-                group.append((name, decode(os.path.join(args.input_dir,
-                                                        name))))
+                group.append((req, decode(path)))
             except Exception as e:
-                attempts[name] = attempts.get(name, 0) + 1
-                if attempts[name] < MAX_ATTEMPTS:
-                    retry_at[name] = time.monotonic() + RETRY_DELAY
-                else:
-                    print(f"WARNING: dropping request {name!r} after "
-                          f"{attempts[name]} failed decodes: {e}",
+                req.attempts += 1
+                if req.attempts >= args.max_attempts:
+                    dest = quarantine.quarantine(
+                        path, f"{req.attempts} failed decodes; last: {e!r}")
+                    print(f"WARNING: quarantined request {req.name!r} "
+                          f"after {req.attempts} failed decodes → "
+                          f"{dest or 'GONE'}: {e}",
                           file=sys.stderr, flush=True)
+                else:
+                    # exponential backoff on the re-enqueue — this IS the
+                    # decode retry path (the dispatch loop must not sleep,
+                    # so backoff lives in the queue, not a blocking
+                    # retry_call). A full queue sheds the retry; dropping
+                    # the name from `seen` lets a later, quieter scan
+                    # re-offer the file instead of stranding it unserved.
+                    if queue.requeue(
+                            req, retry_delay * (2.0 ** (req.attempts - 1))):
+                        reg.counter("retry_attempts_total",
+                                    seam="decode").inc()
+                    else:
+                        seen.discard(req.name)
+                        print(f"WARNING: queue full — decode retry for "
+                              f"{req.name!r} shed; the file stays in the "
+                              "input dir for a later scan",
+                              file=sys.stderr, flush=True)
         if not group:
             return
         stack = np.stack([img for _, img in group])
         batch = {k: stack for k in keys}
         pred, _, n_real = engine.infer_batch(batch)
         paths = [os.path.join(out_dir,
-                              os.path.splitext(name)[0] + ".png")
-                 for name, _ in group]
+                              os.path.splitext(req.name)[0] + ".png")
+                 for req, _ in group]
         writer.submit_batch(pred, paths)
         served += len(group)
-
-    def collect_retries():
-        """Requests whose retry time has come — re-enter the queue."""
-        now = time.monotonic()
-        ready = [n for n, t in retry_at.items() if t <= now]
-        for n in ready:
-            del retry_at[n]
-        return ready
 
     # a custom --buckets list may top out below --max_batch: micro-batches
     # are capped at whichever is smaller, so dispatch never overflows the
     # largest compiled bucket (engine.stream would chunk; infer_batch won't)
     group_cap = min(args.max_batch, engine.buckets[-1])
 
-    def drain_queue(queue):
-        while queue:
-            work = queue[:]
-            del queue[:]
-            for i in range(0, len(work), group_cap):
-                dispatch(work[i : i + group_cap])
+    def drain_queue():
+        """Dispatch everything currently DISPATCHABLE (not in a backoff
+        window); expired requests are dropped — an answer after the
+        deadline serves nobody — with their files left in place."""
+        while True:
+            ready, expired = queue.take(group_cap)
+            for req in expired:
+                print(f"note: request {req.name!r} exceeded its "
+                      f"{args.deadline_ms:.0f} ms deadline — dropped",
+                      file=sys.stderr, flush=True)
+            if not ready:
+                break
+            dispatch(ready)
 
     seen = set()
 
     def scan():
-        fresh = []
+        """Enqueue new arrivals; a full queue sheds them (counted). A
+        shed arrival is dropped from `seen` so a later, quieter scan can
+        re-offer the file — under transient overload shedding defers
+        service rather than permanently denying it (watch mode; --once
+        scans exactly once, so its sheds are final)."""
         try:
             entries = sorted(os.listdir(args.input_dir))
         except FileNotFoundError:
-            return fresh
+            return 0
+        fresh = 0
+        shed_now = 0
         for f in entries:
             if f in seen or not is_image_file(f):
                 continue
             seen.add(f)
-            fresh.append(f)
+            if queue.offer(f):
+                fresh += 1
+            else:
+                seen.discard(f)
+                shed_now += 1
+        if shed_now:
+            print(f"WARNING: queue full ({args.max_queue}) — shed "
+                  f"{shed_now} arrivals (files stay in the input dir for "
+                  "a later scan)", file=sys.stderr, flush=True)
         return fresh
 
-    queue = scan()
-    if args.once:
-        drain_queue(queue)
-        while retry_at:          # wait out the retry windows, then finish
-            time.sleep(RETRY_DELAY / 2)
-            queue.extend(collect_retries())
-            drain_queue(queue)
-    else:
-        try:
-            linger_start = time.perf_counter() if queue else None
-            while args.max_requests is None or served < args.max_requests:
-                if len(queue) >= args.max_batch or (
-                    queue
-                    and linger_start is not None
-                    and (time.perf_counter() - linger_start) * 1e3
-                    >= args.linger_ms
-                ):
-                    drain_queue(queue)
-                    linger_start = None
-                time.sleep(args.poll_ms / 1e3 if not queue else
-                           args.linger_ms / 1e3)
-                fresh = scan() + collect_retries()
-                if fresh and not queue:
-                    linger_start = time.perf_counter()
-                queue.extend(fresh)
-        except KeyboardInterrupt:
-            drain_queue(queue)
-    n_written = writer.drain()
-    writer.close()
+    try:
+        scan()
+        if args.once:
+            drain_queue()
+            while len(queue):    # wait out retry-backoff windows, then finish
+                time.sleep(min(retry_delay / 2, 0.25))
+                drain_queue()
+        else:
+            try:
+                linger_start = time.perf_counter() if len(queue) else None
+                while args.max_requests is None or served < args.max_requests:
+                    if len(queue) >= args.max_batch or (
+                        len(queue)
+                        and linger_start is not None
+                        and (time.perf_counter() - linger_start) * 1e3
+                        >= args.linger_ms
+                    ):
+                        drain_queue()
+                        linger_start = None
+                    time.sleep(args.poll_ms / 1e3 if not len(queue) else
+                               args.linger_ms / 1e3)
+                    scan()
+                    if len(queue) and linger_start is None:
+                        linger_start = time.perf_counter()
+            except KeyboardInterrupt:
+                drain_queue()
+        n_written = writer.drain()
+        writer.close()
+        for path, err in writer.write_errors:
+            print(f"WARNING: prediction write failed permanently for "
+                  f"{path!r}: {err}", file=sys.stderr, flush=True)
+    finally:
+        if args.chaos:
+            # disarm even on a crashed serve: chaos is process-global and
+            # in-process callers (tests) must not inherit the fault spec
+            install_chaos(prev_chaos)
     wall = time.perf_counter() - t0
+
     print(json.dumps({
         "kind": "serve_summary", "served": served, "written": n_written,
         "out_dir": out_dir, "buckets": list(engine.buckets),
         "n_compiles": engine.n_compiles,
         "encode_sec": round(writer.encode_sec, 4),
         "wall_sec": round(wall, 4),
+        "shed": queue.shed_count,
+        "deadline_expired": queue.expired_count,
+        "quarantined": quarantine.count,
+        "write_failures": len(writer.write_errors),
+        "decode_retries": int(reg.counter(
+            "retry_attempts_total", seam="decode").value),
+        "write_retries": int(reg.counter(
+            "retry_attempts_total", seam="serve_write").value),
+        "chaos_injected": int(reg.total("chaos_injected_total")),
     }))
     return 0
 

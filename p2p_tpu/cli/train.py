@@ -307,8 +307,18 @@ def main(argv=None) -> int:
             trainer.state, cfg, workdir=args.workdir,
             g1_dir=args.init_g1_from, mesh=getattr(trainer, "mesh", None),
         )
+    from p2p_tpu.resilience import PREEMPTED_EXIT_CODE, Preempted
+
     try:
         trainer.fit()
+    except Preempted as p:
+        # graceful preemption (SIGTERM/SIGINT): the exact step is on disk —
+        # exit 75 (EX_TEMPFAIL) tells the supervisor "re-run these flags";
+        # the relaunch lands in maybe_resume's exact-step path above.
+        print(f"preempted: checkpoint saved at step {p.step} — "
+              f"relaunch with identical flags to resume "
+              f"(exit {PREEMPTED_EXIT_CODE})", flush=True)
+        return PREEMPTED_EXIT_CODE
     finally:
         trainer.close()  # unhook compile listener + sentinel handler
     return 0

@@ -41,6 +41,12 @@ def load_image(path: str, h: int, w: int,
     decoded bytes instead — the uint8 input pipeline normalizes on device
     (utils/images.ingest), bit-exact with the host normalize because both
     round through the same f32 values.
+
+    Resilience note (docs/RESILIENCE.md): this function itself fails
+    FAST — a decode error on a training input set is a data bug, not a
+    blip. The serve frontend wraps its calls with the ``decode`` chaos
+    seam + re-enqueue-with-backoff + quarantine (cli/serve.py), so chaos
+    drills against ``decode`` never kill a training run.
     """
     from p2p_tpu import native
 
@@ -177,6 +183,39 @@ class _Stacked:
             }
 
 
+_WORKERS_WARNED = False
+
+
+def _warn_fallback_workers(num_workers: int, registry=None) -> None:
+    """One-time (per process) warning that the no-Grain fallback decodes
+    single-threaded — the requested ``num_workers`` silently doing nothing
+    is a perf cliff worth a visible record (obs counter + stderr). The
+    trainers pass their run registry so the record reaches the run's
+    metrics JSONL, not just the sink-less process default."""
+    global _WORKERS_WARNED
+    if _WORKERS_WARNED:
+        return
+    _WORKERS_WARNED = True
+    if registry is None:
+        from p2p_tpu.obs import get_registry
+
+        registry = get_registry()
+    registry.counter("fallback_loader_workers_ignored").inc()
+    registry.record(
+        {"kind": "warn", "what": "fallback_loader_workers_ignored",
+         "num_workers": num_workers},
+        force=True,
+    )
+    import sys
+
+    print(
+        f"WARNING: Grain unavailable — the fallback loader decodes "
+        f"in-process and single-threaded; num_workers={num_workers} is "
+        f"ignored (expect slower epochs on uncached splits)",
+        file=sys.stderr, flush=True,
+    )
+
+
 def make_loader(
     dataset: PairedImageDataset,
     batch_size: int,
@@ -185,19 +224,35 @@ def make_loader(
     num_workers: int = 0,
     num_epochs: Optional[int] = 1,
     drop_remainder: bool = True,
+    skip_batches: int = 0,
+    registry=None,
 ):
     """Host-batch iterator with per-JAX-process sharding.
 
     Uses Grain's DataLoader (worker processes decode in parallel, exactly the
     role of the reference's DataLoader(num_workers=opt.threads)); plain
-    Python fallback keeps tests hermetic if Grain is missing.
+    Python fallback keeps tests hermetic if Grain is missing (or when
+    ``P2P_TPU_NO_GRAIN=1`` forces the fallback — resilience tests pin the
+    fallback's exact-resume accounting).
+
+    ``skip_batches`` drops the FIRST N batches of the FIRST epoch — the
+    exact-step resume path (train/loop.py): a run killed mid-epoch resumes
+    its epoch from batch N without replaying batches 0..N-1. The fallback
+    skips by index arithmetic (no decode cost); Grain consumes and
+    discards N batches once (decode cost paid, order preserved).
     """
     try:
+        if os.environ.get("P2P_TPU_NO_GRAIN") == "1":
+            raise ImportError("fallback forced by P2P_TPU_NO_GRAIN")
         import grain.python as pg
     except Exception:
+        if num_workers > 0:
+            _warn_fallback_workers(num_workers, registry)
+
         def fallback():
             rng = np.random.default_rng(seed)
             epoch = 0
+            skip = max(0, int(skip_batches))
             while num_epochs is None or epoch < num_epochs:
                 idx = np.arange(len(dataset))
                 if shuffle:
@@ -213,6 +268,13 @@ def make_loader(
                     if drop_remainder:
                         idx = idx[: len(idx) - len(idx) % n_proc]
                     idx = idx[jax.process_index()::n_proc]
+                if skip:
+                    # resume mid-epoch: batch i is rows [i·bs, (i+1)·bs), so
+                    # dropping skip·bs leading indices leaves every later
+                    # batch's membership and order IDENTICAL to an
+                    # uninterrupted epoch — zero decodes spent on the skip
+                    idx = idx[skip * batch_size:]
+                    skip = 0
                 yield from _Stacked(dataset, batch_size, list(idx),
                                     drop_remainder)
                 epoch += 1
@@ -232,7 +294,15 @@ def make_loader(
         operations=[pg.Batch(batch_size=batch_size, drop_remainder=drop_remainder)],
         worker_count=num_workers,
     )
-    return iter(loader)
+    it = iter(loader)
+    if skip_batches > 0:
+        def skipping():
+            for i, b in enumerate(it):
+                if i >= skip_batches:
+                    yield b
+
+        return skipping()
+    return it
 
 
 def place_global(batch, sharding):

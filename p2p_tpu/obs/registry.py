@@ -301,6 +301,15 @@ class MetricsRegistry:
             for (name, tags), m in items
         }
 
+    def total(self, name: str) -> float:
+        """Sum a counter's value across ALL tag variants — e.g.
+        ``total("retry_attempts_total")`` over every ``seam=`` tag. Gauges/
+        histograms/EWMAs are excluded (summing those is meaningless)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return sum(m.value for (n, _), m in items
+                   if n == name and m.kind == "counter")
+
     def aggregate(self) -> Dict[str, Dict[str, float]]:
         """Cross-process reduction of the snapshot. On one process this is
         the snapshot itself (combined through the same pure path, so the

@@ -254,9 +254,22 @@ class MetricsLogger:
             self._jsonl = JSONLSink(path)
             self.registry.add_sink(self._jsonl)
         self.registry.add_sink(StdoutSink(print_every))
+        # Abnormal-exit flush (docs/RESILIENCE.md): atexit covers orderly
+        # interpreter teardown (sys.exit, uncaught exception) for EVERY
+        # attached sink — buffered records of the last partial step reach
+        # disk. The signal path is covered separately: the train loop's
+        # PreemptionGuard runs this same flush inside its SIGTERM/SIGINT
+        # handler. (SIGKILL keeps whatever force=True already flushed.)
+        self._atexit_flush = self.registry.flush
+        atexit.register(self._atexit_flush)
 
     def log(self, record: Dict[str, Any], force: bool = False) -> None:
         self.registry.record(record, force=force)
 
     def close(self) -> None:
+        # unhook the atexit flush: processes that build many loggers
+        # (tests, sweeps) must not pin every registry until exit
+        if self._atexit_flush is not None:
+            atexit.unregister(self._atexit_flush)
+            self._atexit_flush = None
         self.registry.close()

@@ -1,0 +1,177 @@
+"""Serve hardening primitives: bounded queue + load shedding, per-request
+deadlines, poison-input quarantine.
+
+A directory-watching frontend (``cli/serve.py``) has three unbounded
+failure modes this module bounds:
+
+- **backlog growth** — a traffic burst (or a slow device) grows the
+  request queue without limit; by the time old requests dispatch their
+  callers are long gone. :class:`BoundedRequestQueue` caps depth and
+  SHEDS the newest arrivals once full (``serve_shed_total``): under
+  overload, serving *some* requests within deadline beats serving all of
+  them too late.
+- **deadline blowthrough** — requests that waited longer than the
+  per-request deadline are dropped at dispatch time
+  (``serve_deadline_expired_total``) instead of burning device time on an
+  answer nobody is waiting for.
+- **poison inputs** — a permanently-corrupt request file fails decode on
+  every attempt; re-enqueueing it forever wedges the server on one bad
+  request. After the attempt cap, :class:`Quarantine` MOVES the file into
+  a ``failed/`` directory (out of the watched set) and counts it
+  (``serve_quarantined_total``) — the 422 of a file-drop RPC.
+
+All counters land on the obs :class:`~p2p_tpu.obs.MetricsRegistry`; the
+queue also keeps a ``serve_queue_depth`` gauge so dashboards see pressure
+building before shedding starts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import time
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued request (a file name, for the directory frontend)."""
+
+    name: str
+    enqueued_at: float
+    attempts: int = 0
+    not_before: float = 0.0   # backoff: don't dispatch before this time
+
+
+class BoundedRequestQueue:
+    """FIFO with a depth cap (shed-newest), deadlines, and retry re-entry."""
+
+    def __init__(
+        self,
+        max_depth: int,
+        deadline_s: Optional[float] = None,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self.deadline_s = deadline_s
+        self._clock = clock
+        self._q: deque = deque()
+        if registry is None:
+            from p2p_tpu.obs import get_registry
+
+            registry = get_registry()
+        self._shed = registry.counter("serve_shed_total")
+        self._expired = registry.counter("serve_deadline_expired_total")
+        self._depth = registry.gauge("serve_queue_depth")
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def shed_count(self) -> int:
+        return int(self._shed.value)
+
+    @property
+    def expired_count(self) -> int:
+        return int(self._expired.value)
+
+    def offer(self, name: str) -> bool:
+        """Enqueue a fresh request; returns False (and counts a shed) when
+        the queue is full — under overload the newest arrivals are the
+        ones turned away, they waited least."""
+        if len(self._q) >= self.max_depth:
+            self._shed.inc()
+            self._depth.set(len(self._q))
+            return False
+        self._q.append(Request(name, self._clock()))
+        self._depth.set(len(self._q))
+        return True
+
+    def requeue(self, req: Request, delay_s: float = 0.0) -> bool:
+        """Re-enter a failed request (attempt accounting is the caller's —
+        bump ``req.attempts`` before requeueing). Sheds when full, like
+        any arrival; keeps its ORIGINAL enqueue time so the deadline
+        covers total time-in-system, not time-since-last-retry."""
+        if len(self._q) >= self.max_depth:
+            self._shed.inc()
+            return False
+        req.not_before = self._clock() + max(0.0, delay_s)
+        self._q.append(req)
+        self._depth.set(len(self._q))
+        return True
+
+    def take(self, n: int) -> Tuple[List[Request], List[Request]]:
+        """Dequeue up to ``n`` dispatchable requests.
+
+        Returns ``(ready, expired)``: expired requests (older than the
+        deadline) are counted and handed back for disposal, never
+        dispatched. Requests inside a retry-backoff window stay queued
+        (they don't block younger requests behind them)."""
+        ready: List[Request] = []
+        expired: List[Request] = []
+        now = self._clock()
+        waiting: List[Request] = []
+        while self._q and len(ready) < n:
+            req = self._q.popleft()
+            if self.deadline_s is not None and \
+                    now - req.enqueued_at > self.deadline_s:
+                self._expired.inc()
+                expired.append(req)
+            elif req.not_before > now:
+                waiting.append(req)   # still backing off; keep for later
+            else:
+                ready.append(req)
+        for req in reversed(waiting):
+            self._q.appendleft(req)   # preserve FIFO order among survivors
+        self._depth.set(len(self._q))
+        return ready, expired
+
+
+class Quarantine:
+    """Move poison inputs out of the watched directory, with a breadcrumb.
+
+    ``quarantine(path, reason)`` moves the file into ``dir`` (created on
+    first use) and writes ``<name>.reason.txt`` beside it naming the final
+    error — the operator's triage note. Returns the new path, or None when
+    the move itself failed (the file may have vanished; never raises into
+    the serve loop)."""
+
+    def __init__(self, directory: str, registry=None):
+        self.directory = directory
+        if registry is None:
+            from p2p_tpu.obs import get_registry
+
+            registry = get_registry()
+        self._count = registry.counter("serve_quarantined_total")
+        self._registry = registry
+
+    @property
+    def count(self) -> int:
+        return int(self._count.value)
+
+    def quarantine(self, path: str, reason: str = "") -> Optional[str]:
+        dest = os.path.join(self.directory, os.path.basename(path))
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            # replace-if-exists semantics: a re-poisoned same-name file
+            # must still leave the watched dir
+            shutil.move(path, dest)
+        except OSError:
+            return None
+        self._count.inc()
+        self._registry.record(
+            {"kind": "quarantine", "file": dest, "reason": reason[:500]},
+            force=True,
+        )
+        if reason:
+            try:
+                with open(dest + ".reason.txt", "w") as f:
+                    f.write(reason + "\n")
+            except OSError:
+                pass
+        return dest

@@ -1,0 +1,133 @@
+"""Retry with exponential backoff + jitter — the transient-fault primitive.
+
+Checkpoint save/restore and image decode sit on storage that fails
+transiently in production (NFS blips, objects mid-upload, files still
+being copied into a watched directory). The policy here is the standard
+one (MegaScale / Pathways stacks, AWS architecture guidance): classify
+the exception, back off exponentially with *full jitter* so a fleet of
+retrying hosts doesn't synchronize into thundering herds, give up on a
+deadline or an attempt cap, and count everything through the obs
+registry:
+
+- ``retry_attempts_total{seam=...}`` — re-attempts performed (not first
+  tries);
+- ``retry_exhausted_total{seam=...}`` — calls that failed permanently.
+
+:class:`~p2p_tpu.resilience.chaos.FaultInjected` is always classified
+retryable — the chaos layer exists to exercise exactly this path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from p2p_tpu.resilience.chaos import FaultInjected
+
+# Transient by default: OS/filesystem errors (includes PIL's
+# UnidentifiedImageError for half-copied request files), timeouts, and
+# injected chaos faults. ValueError/TypeError/etc. stay fatal — retrying
+# a programming error just hides it for max_attempts * backoff seconds.
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    OSError, TimeoutError, FaultInjected,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff shape + give-up rules for one seam."""
+
+    max_attempts: int = 4           # total tries (1 first try + 3 retries)
+    base_delay: float = 0.05        # seconds before the first retry
+    max_delay: float = 2.0          # per-retry backoff cap
+    jitter: bool = True             # full jitter: delay ~ U(0, backoff]
+    deadline: Optional[float] = None  # total wall-clock budget (seconds)
+    retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retryable)
+
+    def backoff(self, attempt: int, rng: Optional[random.Random] = None
+                ) -> float:
+        """Delay before retry number ``attempt`` (1-based)."""
+        raw = min(self.max_delay, self.base_delay * (2.0 ** (attempt - 1)))
+        if not self.jitter:
+            return raw
+        r = rng.random() if rng is not None else random.random()
+        return raw * (0.5 + 0.5 * r)  # U(raw/2, raw]: jittered, never 0
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+# Checkpoint I/O tolerates longer waits — a blipping FS usually recovers
+# within seconds. (Serve-side decode deliberately does NOT use a blocking
+# retry_call: the dispatch loop must never sleep, so its backoff lives in
+# the request queue's re-enqueue windows — cli/serve.py — counted on the
+# same retry_attempts_total{seam=decode} counter.)
+CKPT_POLICY = RetryPolicy(max_attempts=4, base_delay=0.2, max_delay=5.0)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    seam: str = "op",
+    registry=None,
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying retryable failures.
+
+    Retries up to ``policy.max_attempts`` total tries with exponential
+    backoff + jitter, stopping early when ``policy.deadline`` seconds have
+    elapsed since the first try. Non-retryable exceptions propagate
+    immediately; the final retryable failure is re-raised unchanged (with
+    ``retry_exhausted_total`` bumped).
+    """
+    if registry is None:
+        from p2p_tpu.obs import get_registry
+
+        registry = get_registry()
+    t0 = clock()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            if not policy.is_retryable(exc):
+                raise
+            delay = policy.backoff(attempt, rng)
+            out_of_attempts = attempt >= policy.max_attempts
+            out_of_time = (policy.deadline is not None
+                           and clock() - t0 + delay > policy.deadline)
+            if out_of_attempts or out_of_time:
+                registry.counter("retry_exhausted_total", seam=seam).inc()
+                raise
+            registry.counter("retry_attempts_total", seam=seam).inc()
+            registry.record(
+                {"kind": "retry", "seam": seam, "attempt": attempt,
+                 "delay_sec": round(delay, 4), "error": repr(exc)},
+            )
+            sleep(delay)
+
+
+def retrying(policy: RetryPolicy = DEFAULT_POLICY, seam: str = "op",
+             **retry_kw):
+    """Decorator form of :func:`retry_call`."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy, seam=seam,
+                              **retry_kw, **kwargs)
+
+        return wrapped
+
+    return deco

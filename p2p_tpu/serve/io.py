@@ -14,6 +14,12 @@ module removes:
   fetch + encode into a thread pool, so encoding overlaps device compute
   (the fetch releases the GIL; the breakdown numbers in
   ``InferenceEngine.run`` make the overlap measurable).
+
+Output writes are crash-safe (p2p_tpu.resilience): each PNG is encoded to
+``<path>.tmp.<pid>`` and atomically renamed into place, so a consumer
+watching the output directory can never read a torn file and a killed
+server leaves no half-written predictions under served names; the write
+itself runs under the retry policy with a ``serve_write`` chaos seam.
 """
 
 from __future__ import annotations
@@ -26,7 +32,34 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from p2p_tpu.resilience.chaos import chaos_point
+from p2p_tpu.resilience.retry import RetryPolicy, retry_call
 from p2p_tpu.utils.images import save_img
+
+# serve-side write policy: quick retries (a worker thread is holding a
+# whole prediction batch in host RAM while it waits)
+WRITE_POLICY = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=0.5)
+
+
+def save_img_atomic(arr, path: str) -> None:
+    """``save_img`` via temp-file + rename: the file appears at ``path``
+    complete or not at all (readers of a watched output dir never see a
+    torn PNG; a killed process leaves only a ``.tmp.`` file to sweep).
+    The tmp name keeps the real extension as ITS suffix (PIL routes the
+    encoder by extension) and starts with a dot so directory watchers
+    keyed on image extensions don't pick it up mid-write."""
+    d, base = os.path.split(path)
+    tmp = os.path.join(d, f".tmp.{os.getpid()}.{base}")
+    try:
+        chaos_point("serve_write")
+        save_img(arr, tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def pick_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -81,18 +114,28 @@ class AsyncImageWriter:
     ``submit_batch`` blocks on the oldest one. Every queued task pins its
     device prediction buffers until a worker fetches them — unbounded
     queuing would grow HBM/host memory with the encode backlog on long
-    runs where the device outruns the encoders."""
+    runs where the device outruns the encoders.
 
-    def __init__(self, workers: int = 4, max_pending: Optional[int] = None):
+    ``fail_fast=False`` (the serving frontend): a write that exhausts its
+    retries is recorded in ``write_errors`` and the batch continues —
+    one poison output path (a directory squatting on the target name, a
+    dead output volume) must never kill the server. ``fail_fast=True``
+    (default, the offline/bench path) surfaces the first error at
+    ``drain()`` — there, silent loss would corrupt the reported run."""
+
+    def __init__(self, workers: int = 4, max_pending: Optional[int] = None,
+                 fail_fast: bool = True):
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, workers), thread_name_prefix="p2p-serve-io")
         self.max_pending = (max_pending if max_pending is not None
                             else 4 * max(1, workers))
+        self.fail_fast = fail_fast
         self._futures: List[Future] = []
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self.n_written = 0
         self.encode_sec = 0.0
+        self.write_errors: List[Tuple[str, BaseException]] = []
 
     def _write_batch(self, pred: Any, paths: Sequence[str]) -> None:
         t0 = time.perf_counter()
@@ -100,14 +143,25 @@ class AsyncImageWriter:
         # never a per-image device slice (each distinct static index would
         # compile its own tiny slice program mid-serve)
         arr = np.asarray(pred, np.float32)
+        n_ok = 0
         for i, path in enumerate(paths):
             d = os.path.dirname(path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            save_img(arr[i], path)
+            # atomic + retried: transient FS failures (and injected
+            # serve_write chaos) are absorbed here, on the worker thread
+            try:
+                retry_call(save_img_atomic, arr[i], path,
+                           policy=WRITE_POLICY, seam="serve_write")
+                n_ok += 1
+            except BaseException as e:
+                if self.fail_fast:
+                    raise
+                with self._lock:
+                    self.write_errors.append((path, e))
         dt = time.perf_counter() - t0
         with self._lock:
-            self.n_written += len(paths)
+            self.n_written += n_ok
             self.encode_sec += dt
 
     def _prune_done(self) -> None:

@@ -13,16 +13,29 @@ Orbax gives async save (non-blocking on TPU), restore-to-sharding (pass the
 mesh-placed abstract state and arrays land already sharded), and retention
 policies — the TPU-native story for the failure-recovery subsystem
 (SURVEY §5.3/5.4).
+
+Resilience wiring (p2p_tpu.resilience): save/restore run under the
+exponential-backoff retry policy ``CKPT_POLICY`` with chaos points at the
+``ckpt_save``/``ckpt_restore`` seams, and :meth:`CheckpointManager.
+save_aux`/:meth:`restore_aux` keep a tiny JSON sidecar per step — the
+data-iterator state (epoch, in-epoch batch position, aug seed) that makes
+a mid-epoch checkpoint resumable to the EXACT sample (train/loop.py
+maybe_resume). The sidecar lives in a SIBLING ``<dir>.aux/`` directory:
+Orbax owns the checkpoint directory's layout, and a foreign subdir there
+would trip its step scan.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from p2p_tpu.resilience.chaos import chaos_point
+from p2p_tpu.resilience.retry import CKPT_POLICY, retry_call
 from p2p_tpu.train.state import TrainState
 
 
@@ -45,9 +58,15 @@ def _restore_arg(abstract_leaf):
 class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
 
-    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None,
+                 registry=None):
         directory = os.path.abspath(directory)
         os.makedirs(directory, exist_ok=True)
+        self._aux_dir = directory + ".aux"
+        # retry/chaos counters land here (None = the process default
+        # registry); the trainers pass their run's registry so checkpoint
+        # retries show up in the run's own metrics stream
+        self._registry = registry
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -56,9 +75,21 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: TrainState, wait: bool = False) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
-        if wait:
-            self._mgr.wait_until_finished()
+        def _save():
+            chaos_point("ckpt_save", step=step)
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+            if wait:
+                self._mgr.wait_until_finished()
+
+        # retry the transient failures (FS blips, injected chaos); a step
+        # the manager already holds — e.g. a retry racing an async save
+        # that DID land — is success, not an error
+        try:
+            retry_call(_save, policy=CKPT_POLICY, seam="ckpt_save",
+                       registry=self._registry)
+        except ValueError:
+            if step not in (self._mgr.all_steps() or []):
+                raise
 
     def restore(self, state_template: TrainState, step: Optional[int] = None):
         """Restore into the structure/sharding of ``state_template``."""
@@ -67,7 +98,43 @@ class CheckpointManager:
             raise FileNotFoundError("no checkpoint found")
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           state_template)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+        def _restore():
+            chaos_point("ckpt_restore", step=step)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+
+        return retry_call(_restore, policy=CKPT_POLICY, seam="ckpt_restore",
+                          registry=self._registry)
+
+    # -- iterator-state sidecar (exact-step resume) -----------------------
+    def save_aux(self, step: int, payload: Dict[str, Any]) -> None:
+        """Atomically write the JSON sidecar for ``step`` (tmp + rename —
+        a kill mid-write must never leave a torn sidecar that poisons the
+        next resume)."""
+        os.makedirs(self._aux_dir, exist_ok=True)
+        path = os.path.join(self._aux_dir, f"{int(step)}.json")
+        tmp = path + f".tmp.{os.getpid()}"
+
+        def _write():
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+
+        retry_call(_write, policy=CKPT_POLICY, seam="ckpt_save",
+                   registry=self._registry)
+
+    def restore_aux(self, step: int) -> Optional[Dict[str, Any]]:
+        """The sidecar saved with ``step``, or None (pre-resilience
+        checkpoints have none — resume falls back to derived state)."""
+        path = os.path.join(self._aux_dir, f"{int(step)}.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
 
     def restore_subtree(self, template: Any, step: Optional[int] = None):
         """Restore ONLY the subtree(s) named by ``template`` from a full

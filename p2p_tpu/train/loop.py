@@ -14,6 +14,17 @@ Telemetry goes through :mod:`p2p_tpu.obs`: the JSONL/stdout ``MetricsLogger``
 (formerly defined here), a per-run manifest written at startup, wall-clock
 spans exported as Perfetto JSON at the end of ``fit()``, a recompile
 watchdog armed after the warmup epoch, and per-device HBM sampling.
+
+Fault tolerance goes through :mod:`p2p_tpu.resilience`: ``fit()`` installs
+a :class:`~p2p_tpu.resilience.PreemptionGuard` (SIGTERM/SIGINT → flag),
+the dispatch loop polls it at step boundaries (cross-host agreed), and a
+preemption saves an EXACT-STEP checkpoint — TrainState plus the
+data-iterator sidecar (epoch, in-epoch batch position, aug seed) — then
+raises :class:`~p2p_tpu.resilience.Preempted`, which ``cli/train.py``
+turns into exit code 75. ``maybe_resume`` reverses it: a mid-epoch step
+resumes its epoch at the exact next batch (``make_loader(skip_batches=)``)
+so no sample is replayed or skipped — pinned bitwise-equal to an
+uninterrupted run by tests/test_resilience.py.
 """
 
 from __future__ import annotations
@@ -37,6 +48,7 @@ from p2p_tpu.obs import (
     add_sentinel_handler,
     write_manifest,
 )
+from p2p_tpu.resilience import Preempted, PreemptionGuard
 from p2p_tpu.train.checkpoint import CheckpointManager
 from p2p_tpu.train.schedules import PlateauController
 from p2p_tpu.train.state import create_train_state
@@ -95,6 +107,115 @@ def close_trainer_obs(tr) -> None:
     if getattr(tr, "_sentinel_handler", None) is not None:
         remove_sentinel_handler(tr._sentinel_handler)
         tr._sentinel_handler = None
+
+
+def save_trainer_ckpt(tr, wait: bool = False) -> int:
+    """Checkpoint the trainer's TrainState AND the data-iterator sidecar
+    (epoch, in-epoch batch position, aug seed) — together they name an
+    exact point in the sample stream, so any checkpoint (epoch-boundary or
+    mid-epoch preemption) resumes without replaying or skipping samples.
+    Shared by both trainers; returns the saved step."""
+    step = int(tr.state.step)
+    tr.ckpt.save(step, tr.state, wait=wait)
+    tr.ckpt.save_aux(step, {
+        "step": step,
+        "epoch": tr.epoch,
+        "batches_done": step % tr.steps_per_epoch,
+        "steps_per_epoch": tr.steps_per_epoch,
+        "aug_seed": tr.cfg.train.seed + tr.epoch,
+    })
+    return step
+
+
+def finish_preempted(tr) -> None:
+    """The preemption epilogue both trainers share: exact-step save (wait —
+    the process exits right after; an async save racing SIGKILL at the end
+    of the grace window would be torn), telemetry flush, span export, then
+    raise :class:`Preempted` for the CLI to turn into exit code 75."""
+    with tr.spans.span("preempt_save", epoch=tr.epoch):
+        step = save_trainer_ckpt(tr, wait=True)
+    guard = getattr(tr, "preempt", None)
+    tr.logger.log(
+        {"kind": "preempt", "epoch": tr.epoch, "step": step,
+         "signum": getattr(guard, "signum", None) or 0},
+        force=True,
+    )
+    if jax.process_index() == 0:
+        tr.spans.export_perfetto(tr._trace_path)
+    tr.logger.registry.flush()
+    raise Preempted(step, getattr(guard, "signum", None))
+
+
+def derive_resume_position(tr, step: int):
+    """``(done_full_epochs, mid_batches)`` for a restored checkpoint step,
+    shared by both trainers' ``maybe_resume``.
+
+    Derived from ``step % steps_per_epoch``, then cross-checked against
+    (and overridden by) the iterator sidecar when present — a sidecar
+    disagreeing on steps_per_epoch means the dataset or batch size changed
+    under the checkpoint, where the sidecar's recorded position is the
+    ground truth. Sets ``tr._resume_skip`` and logs the ``kind="resume"``
+    record for mid-epoch re-entries."""
+    done, mid = divmod(int(step), tr.steps_per_epoch)
+    aux = tr.ckpt.restore_aux(int(step))
+    if aux is not None and aux.get("batches_done") is not None:
+        if int(aux.get("steps_per_epoch", tr.steps_per_epoch)) \
+                != tr.steps_per_epoch:
+            print(
+                f"WARNING: checkpoint step {step} was saved with "
+                f"steps_per_epoch={aux.get('steps_per_epoch')} but this "
+                f"run has {tr.steps_per_epoch} — exact-step resume "
+                "alignment is not guaranteed (did the dataset or batch "
+                "size change?)", flush=True)
+        mid = int(aux["batches_done"])
+        done = (int(step) - mid) // tr.steps_per_epoch
+        # the sidecar's aug_seed encodes train.seed + epoch at save time;
+        # a different --seed on the relaunch reshuffles the epoch, so the
+        # skip below would drop batches of a DIFFERENT permutation —
+        # replayed/skipped samples the step counter cannot see
+        want_aug = tr.cfg.train.seed + done + 1
+        if mid and int(aux.get("aug_seed", want_aug)) != want_aug:
+            print(
+                f"WARNING: mid-epoch resume with a different --seed "
+                f"(checkpoint aug_seed={aux.get('aug_seed')}, this run "
+                f"would use {want_aug}): the interrupted epoch's sample "
+                "order cannot be reproduced — expect replayed/skipped "
+                "samples. Relaunch with the original --seed for exact "
+                "resume.", flush=True)
+    tr._resume_skip = mid
+    if mid:
+        tr.logger.log(
+            {"kind": "resume", "step": int(step), "epoch": done + 1,
+             "batches_done": mid},
+            force=True,
+        )
+    return done, mid
+
+
+def acquire_preempt_guard(tr):
+    """fit()-scoped guard ownership, shared by both trainers: install a
+    :class:`PreemptionGuard` unless the caller injected one (tests drive
+    the flag programmatically). Returns the OWNED guard for
+    :func:`release_preempt_guard`, or None (injected guard, or signal
+    handlers unavailable off the main thread — run unguarded rather than
+    crash)."""
+    if tr.preempt is not None:
+        return None
+    try:
+        guard = PreemptionGuard(registry=tr.obs).install()
+    except ValueError:
+        return None
+    # buffered telemetry survives even if the grace window expires
+    # before the step boundary saves
+    guard.add_flush_hook(tr.logger.registry.flush)
+    tr.preempt = guard
+    return guard
+
+
+def release_preempt_guard(tr, owned_guard) -> None:
+    if owned_guard is not None:
+        owned_guard.uninstall()
+        tr.preempt = None
 
 
 def local_metric_rows(vec) -> np.ndarray:
@@ -301,17 +422,25 @@ class Trainer:
         ckpt_dir = os.path.join(
             workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
         )
-        self.ckpt = CheckpointManager(ckpt_dir)
         self.logger = MetricsLogger(
             os.path.join(workdir, f"metrics_{cfg.name}.jsonl"),
             cfg.train.log_every,
         )
         self.obs = self.logger.registry
+        # ckpt after logger: checkpoint retry/chaos counters belong to
+        # THIS run's registry, not the process default
+        self.ckpt = CheckpointManager(ckpt_dir, registry=self.obs)
         self._init_obs()
         self.plateau = (
             PlateauController() if cfg.optim.lr_policy == "plateau" else None
         )
         self.epoch = cfg.train.epoch_count
+        # Fault tolerance (p2p_tpu.resilience): fit() installs a guard
+        # unless the caller injected one (tests / external schedulers);
+        # _resume_skip is the mid-epoch batch offset maybe_resume derives.
+        self.preempt: Optional[PreemptionGuard] = None
+        self._preempted = False
+        self._resume_skip = 0
 
     def _init_obs(self) -> None:
         init_trainer_obs(self)
@@ -406,10 +535,16 @@ class Trainer:
         if step is None:
             return False
         self.state = self.ckpt.restore(self.state)
-        done = int(step) // self.steps_per_epoch
+        # Exact-step resume: a mid-epoch (preemption) checkpoint re-enters
+        # its epoch at batch `mid` — the loader skips exactly the batches
+        # the killed run consumed (same shuffle: the epoch seed is a pure
+        # function of the epoch label).
+        done, mid = derive_resume_position(self, int(step))
         # --epoch_count N means "continue labeling at epoch N" (reference
         # train.py:137,253-255); without it the restored step names the
-        # epoch.
+        # epoch. `1 + done` covers both boundary and mid-epoch resumes: a
+        # partially-done epoch (mid > 0) re-enters ITSELF as epoch done+1,
+        # with the loader skipping its consumed batches.
         self.epoch = max(self.cfg.train.epoch_count, 1 + done)
         # The restored optimizer step already encodes `done` epochs, so
         # the schedule's compiled-in offset must be the flag MINUS those:
@@ -437,7 +572,8 @@ class Trainer:
             self.plateau.scale = float(np.asarray(self.state.lr_scale))
         return True
 
-    def train_epoch(self, seed: Optional[int] = None) -> Dict[str, float]:
+    def train_epoch(self, seed: Optional[int] = None,
+                    skip_batches: int = 0) -> Dict[str, float]:
         cfg = self.cfg
         # Per-epoch entropy (shuffle order + augmentation crops),
         # reproducible across same-seed runs. Defaults to the current
@@ -455,6 +591,7 @@ class Trainer:
         loader = make_loader(
             self.train_ds, self.local_bs, shuffle=True,
             seed=cfg.train.seed + seed, num_workers=workers,
+            skip_batches=skip_batches, registry=self.obs,
         )
         # Keep a device-side running sum (no host sync mid-epoch, no buffer
         # pile-up) and transfer ONCE at epoch end, so averages cover EVERY
@@ -576,6 +713,13 @@ class Trainer:
 
         for batch, k in dispatch_batches():
             run(batch, k)
+            # Preemption poll at the step boundary (cross-host agreed —
+            # every process runs the same dispatch count, so the agreement
+            # collective stays aligned). The flag is only SET here; fit()
+            # owns the save-and-exit policy.
+            if self.preempt is not None and self.preempt.should_stop():
+                self._preempted = True
+                break
         if sums is None:
             return {}
         host_sums = jax.device_get(sums)  # fences the epoch's last step
@@ -723,43 +867,59 @@ class Trainer:
         nepoch = nepoch or cfg.train.nepoch
         history = []
         first_epoch = self.epoch
-        while self.epoch <= nepoch:
-            t0 = time.time()
-            with self.spans.span("epoch", epoch=self.epoch):
-                train_metrics = self.train_epoch(seed=self.epoch)
-                record = {"epoch": self.epoch, "sec": time.time() - t0,
-                          **train_metrics}
-                lr = self.current_lr()
-                if lr is not None:  # reference prints LR per epoch (networks.py:125)
-                    record["lr"] = lr
-                if cfg.train.eval_every_epoch:
-                    record.update(self.evaluate(save_samples=True))
-            history.append(record)
-            # epoch summary (incl. lr) into the metrics stream — the
-            # jsonl otherwise only carries per-step and eval records, so
-            # LR continuity across a resume would be unobservable
-            self.logger.log({"kind": "epoch", **record}, force=True)
-            self.memwatch.sample(self.logger)  # HBM fill/peak (no-op on CPU)
-            if self.plateau is not None and "loss_g" in record:
-                # feed the generator loss, mode='min' (reference plateau);
-                # the returned scale multiplies every optimizer update
-                # inside the jitted step via TrainState.lr_scale.
-                scale = self.plateau.update(record["loss_g"])
-                import jax.numpy as jnp
+        self._preempted = False
+        owned_guard = acquire_preempt_guard(self)
+        try:
+            while self.epoch <= nepoch:
+                t0 = time.time()
+                # exact-step resume: the first epoch after a mid-epoch
+                # restore skips exactly the batches the killed run consumed
+                skip = self._resume_skip
+                self._resume_skip = 0
+                with self.spans.span("epoch", epoch=self.epoch):
+                    train_metrics = self.train_epoch(seed=self.epoch,
+                                                     skip_batches=skip)
+                    record = {"epoch": self.epoch, "sec": time.time() - t0,
+                              **train_metrics}
+                    lr = self.current_lr()
+                    if lr is not None:  # reference prints LR per epoch (networks.py:125)
+                        record["lr"] = lr
+                    if cfg.train.eval_every_epoch and not self._preempted:
+                        record.update(self.evaluate(save_samples=True))
+                if self._preempted:
+                    # partial epoch: no epoch record (downstream tooling
+                    # reads those as COMPLETED epochs) — save the exact
+                    # step + iterator sidecar and exit as "resume me"
+                    finish_preempted(self)  # raises Preempted
+                history.append(record)
+                # epoch summary (incl. lr) into the metrics stream — the
+                # jsonl otherwise only carries per-step and eval records, so
+                # LR continuity across a resume would be unobservable
+                self.logger.log({"kind": "epoch", **record}, force=True)
+                self.memwatch.sample(self.logger)  # HBM fill/peak (no-op on CPU)
+                if self.plateau is not None and "loss_g" in record:
+                    # feed the generator loss, mode='min' (reference plateau);
+                    # the returned scale multiplies every optimizer update
+                    # inside the jitted step via TrainState.lr_scale.
+                    scale = self.plateau.update(record["loss_g"])
+                    import jax.numpy as jnp
 
-                self.state = self.state.replace(
-                    lr_scale=jnp.asarray(scale, jnp.float32)
-                )
-            if self.epoch % cfg.train.epoch_save == 0 or self.epoch == nepoch:
-                with self.spans.span("checkpoint_save", epoch=self.epoch):
-                    self.ckpt.save(int(self.state.step), self.state)
-            if self.epoch == first_epoch:
-                # warmup epoch compiled every dispatch shape (scan body,
-                # remainder, eval, comp_fn) — compiles from here on are
-                # suspect. The first async checkpoint save may still warn
-                # once; the watchdog only reports, never raises.
-                self.retrace.arm()
-            self.epoch += 1
+                    self.state = self.state.replace(
+                        lr_scale=jnp.asarray(scale, jnp.float32)
+                    )
+                if self.epoch % cfg.train.epoch_save == 0 \
+                        or self.epoch == nepoch:
+                    with self.spans.span("checkpoint_save", epoch=self.epoch):
+                        save_trainer_ckpt(self)
+                if self.epoch == first_epoch:
+                    # warmup epoch compiled every dispatch shape (scan body,
+                    # remainder, eval, comp_fn) — compiles from here on are
+                    # suspect. The first async checkpoint save may still warn
+                    # once; the watchdog only reports, never raises.
+                    self.retrace.arm()
+                self.epoch += 1
+        finally:
+            release_preempt_guard(self, owned_guard)
         self.ckpt.wait()
         # Perfetto-loadable host-span trace next to the metrics stream
         # (each fit() call rewrites it with the accumulated spans).

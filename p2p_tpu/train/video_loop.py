@@ -24,8 +24,17 @@ from p2p_tpu.data.video import VideoClipDataset
 from p2p_tpu.losses import psnr, ssim
 from p2p_tpu.models.vgg import load_vgg19_params
 from p2p_tpu.obs import MetricsLogger
+from p2p_tpu.resilience import PreemptionGuard
 from p2p_tpu.train.checkpoint import CheckpointManager
-from p2p_tpu.train.loop import close_trainer_obs, init_trainer_obs
+from p2p_tpu.train.loop import (
+    acquire_preempt_guard,
+    close_trainer_obs,
+    derive_resume_position,
+    finish_preempted,
+    init_trainer_obs,
+    release_preempt_guard,
+    save_trainer_ckpt,
+)
 from p2p_tpu.utils.images import ingest
 from p2p_tpu.train.video_step import (
     build_video_models,
@@ -114,16 +123,20 @@ class VideoTrainer:
         self.plateau = (
             PlateauController() if cfg.optim.lr_policy == "plateau" else None
         )
-        self.ckpt = CheckpointManager(os.path.join(
-            workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
-        ))
         self.logger = MetricsLogger(
             os.path.join(workdir, f"metrics_{cfg.name}.jsonl"),
             cfg.train.log_every,
         )
         self.obs = self.logger.registry
+        # ckpt after logger: retry/chaos counters on THIS run's registry
+        self.ckpt = CheckpointManager(os.path.join(
+            workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
+        ), registry=self.obs)
         init_trainer_obs(self)  # manifest + spans + watchdogs (p2p_tpu.obs)
         self.epoch = cfg.train.epoch_count
+        self.preempt: Optional[PreemptionGuard] = None
+        self._preempted = False
+        self._resume_skip = 0
 
     def close(self) -> None:
         """Release process-global telemetry hooks (safe to call twice)."""
@@ -162,7 +175,10 @@ class VideoTrainer:
         if step is None:
             return False
         self.state = self.ckpt.restore(self.state)
-        done = int(step) // self.steps_per_epoch
+        # exact-step resume (shared with Trainer.maybe_resume): a
+        # mid-epoch (preemption) checkpoint re-enters its epoch at
+        # clip-batch `mid`
+        done, mid = derive_resume_position(self, int(step))
         self.epoch = max(self.cfg.train.epoch_count, 1 + done)
         # Renormalize the schedule's epoch offset against the restored
         # step (see Trainer.maybe_resume for the double-offset analysis;
@@ -180,12 +196,14 @@ class VideoTrainer:
             self.plateau.scale = float(np.asarray(self.state.lr_scale))
         return True
 
-    def train_epoch(self, seed: int = 0) -> Dict[str, float]:
+    def train_epoch(self, seed: int = 0,
+                    skip_batches: int = 0) -> Dict[str, float]:
         cfg = self.cfg
         loader = make_loader(
             self.train_ds, self.local_bs, shuffle=True,
             seed=cfg.train.seed + seed,
             num_workers=cfg.data.threads if len(self.train_ds) > 64 else 0,
+            skip_batches=skip_batches, registry=self.obs,
         )
         sums = None
         count = 0
@@ -280,6 +298,10 @@ class VideoTrainer:
 
         for batch, k in dispatch():
             run(batch, k)
+            # preemption poll at the step boundary (cf. Trainer.train_epoch)
+            if self.preempt is not None and self.preempt.should_stop():
+                self._preempted = True
+                break
         if sums is None:
             return {}
         host = jax.device_get(sums)
@@ -362,26 +384,41 @@ class VideoTrainer:
         nepoch = nepoch or cfg.train.nepoch
         history = []
         first_epoch = self.epoch
-        while self.epoch <= nepoch:
-            with self.spans.span("epoch", epoch=self.epoch):
-                record = {"epoch": self.epoch,
-                          **self.train_epoch(seed=self.epoch)}
-                if cfg.train.eval_every_epoch:
-                    record.update(self.evaluate())
-            history.append(record)
-            self.logger.log({"kind": "epoch", **record}, force=True)
-            self.memwatch.sample(self.logger)
-            if self.plateau is not None and "loss_g" in record:
-                scale = self.plateau.update(record["loss_g"])
-                self.state = self.state.replace(
-                    lr_scale=jnp.asarray(scale, jnp.float32)
-                )
-            if self.epoch % cfg.train.epoch_save == 0 or self.epoch == nepoch:
-                with self.spans.span("checkpoint_save", epoch=self.epoch):
-                    self.ckpt.save(int(self.state.step), self.state)
-            if self.epoch == first_epoch:
-                self.retrace.arm()  # warmup compiles done; see Trainer.fit
-            self.epoch += 1
+        self._preempted = False
+        # preemption guard (p2p_tpu.resilience) — same protocol as the
+        # image Trainer: flag at the signal, exact-step save + Preempted
+        # at the next step boundary, exact-step resume via maybe_resume's
+        # skip_batches path.
+        owned_guard = acquire_preempt_guard(self)
+        try:
+            while self.epoch <= nepoch:
+                skip = self._resume_skip
+                self._resume_skip = 0
+                with self.spans.span("epoch", epoch=self.epoch):
+                    record = {"epoch": self.epoch,
+                              **self.train_epoch(seed=self.epoch,
+                                                 skip_batches=skip)}
+                    if cfg.train.eval_every_epoch and not self._preempted:
+                        record.update(self.evaluate())
+                if self._preempted:
+                    finish_preempted(self)  # raises Preempted
+                history.append(record)
+                self.logger.log({"kind": "epoch", **record}, force=True)
+                self.memwatch.sample(self.logger)
+                if self.plateau is not None and "loss_g" in record:
+                    scale = self.plateau.update(record["loss_g"])
+                    self.state = self.state.replace(
+                        lr_scale=jnp.asarray(scale, jnp.float32)
+                    )
+                if self.epoch % cfg.train.epoch_save == 0 \
+                        or self.epoch == nepoch:
+                    with self.spans.span("checkpoint_save", epoch=self.epoch):
+                        save_trainer_ckpt(self)
+                if self.epoch == first_epoch:
+                    self.retrace.arm()  # warmup compiles done; see Trainer.fit
+                self.epoch += 1
+        finally:
+            release_preempt_guard(self, owned_guard)
         self.ckpt.wait()
         if jax.process_index() == 0:
             self.spans.export_perfetto(self._trace_path)

@@ -138,3 +138,106 @@ def test_kill_mid_run_then_resume_continues(tmp_path):
         assert rec["lr"] == pytest.approx(2e-4 * max(0.0, mult), rel=1e-4), (
             f"epoch {e_abs}: lr {rec['lr']} != expected {2e-4 * mult}"
         )
+
+
+def _train_steps(path):
+    """Step numbers of every kind=train record, in file order."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "train":
+                out.append(int(rec["step"]))
+    return out
+
+
+@pytest.mark.slow
+def test_sigterm_mid_epoch_exact_resume(tmp_path):
+    """Graceful-preemption path end-to-end (p2p_tpu.resilience): SIGTERM a
+    REAL training CLI mid-epoch; it must save an exact-step checkpoint and
+    exit with PREEMPTED_EXIT_CODE (75); the relaunch must resume INSIDE
+    the interrupted epoch and finish, with per-step records (log_every=1,
+    fallback loader) forming one gapless, repeat-free step sequence —
+    exact sample accounting: nothing replayed, nothing skipped."""
+    from p2p_tpu.resilience import PREEMPTED_EXIT_CODE
+
+    # one long epoch (spe=300, bs=1) so the kill lands mid-epoch with
+    # margin: post-compile CPU steps are ~10 ms, the poll sees the step
+    # counter grow and fires around step ~30
+    n_train = 300
+    root = make_synthetic_dataset(str(tmp_path / "data"), n_train, 2, size=16)
+    wd = str(tmp_path / "w")
+    os.makedirs(wd)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["P2P_TPU_NO_GRAIN"] = "1"   # the fallback-loader accounting pin
+    metrics = os.path.join(wd, "metrics_kr.jsonl")
+    args = [
+        "--preset", "facades", "--data_root", root, "--workdir", wd,
+        "--name", "kr", "--dataset", "krsynth",
+        "--image_size", "16", "--batch_size", "1", "--test_batch_size", "2",
+        "--ngf", "4", "--ndf", "4", "--threads", "0",
+        "--nepoch", "1", "--niter", "1", "--niter_decay", "0",
+        "--epochsave", "1", "--seed", "0", "--lambda_vgg", "0",
+        "--log_every", "1",
+    ]
+
+    # ---- run 1: SIGTERM once a handful of steps are logged
+    log1 = os.path.join(wd, "run1.log")
+    with open(log1, "w") as lf:
+        p = subprocess.Popen(
+            [sys.executable, "-c", _SHIM] + args,
+            env=env, stdout=lf, stderr=subprocess.STDOUT, text=True,
+        )
+    deadline = time.time() + 540
+    sent = False
+    while time.time() < deadline:
+        if p.poll() is not None:
+            break
+        if not sent and os.path.exists(metrics) and \
+                len(_train_steps(metrics)) >= 5:
+            p.send_signal(signal.SIGTERM)   # the graceful-preemption path
+            sent = True
+        time.sleep(0.1)
+    assert sent, "run 1 finished before any SIGTERM could be sent"
+    rc = p.wait(timeout=120)
+    with open(log1) as f:
+        out1 = f.read()
+    assert rc == PREEMPTED_EXIT_CODE, f"exit {rc}, log tail:\n{out1[-3000:]}"
+    assert "preempted: checkpoint saved at step" in out1
+
+    # the preempt record names the exact saved step — mid-epoch by design
+    recs = [json.loads(line) for line in open(metrics)]
+    pre = [r for r in recs if r.get("kind") == "preempt"]
+    assert len(pre) == 1
+    saved_step = int(pre[0]["step"])
+    assert 0 < saved_step < n_train, \
+        f"kill was not mid-epoch (step {saved_step} of {n_train})"
+    ckpt_dir = os.path.join(wd, "checkpoint", "krsynth", "kr")
+    assert os.path.isdir(os.path.join(ckpt_dir, str(saved_step)))
+    steps1 = _train_steps(metrics)
+    assert steps1 == list(range(1, saved_step + 1)), \
+        "run 1's logged steps don't match its saved step"
+
+    # ---- run 2: identical flags; resumes INSIDE the epoch and finishes
+    out2 = subprocess.run(
+        [sys.executable, "-c", _SHIM] + args,
+        env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert out2.returncode == 0, out2.stdout[-3000:] + out2.stderr[-2000:]
+    assert "resumed at epoch" in out2.stdout
+
+    recs = [json.loads(line) for line in open(metrics)]
+    resume = [r for r in recs if r.get("kind") == "resume"]
+    assert resume and int(resume[0]["batches_done"]) == saved_step % n_train
+
+    # exact sample accounting on the fallback loader: the union of both
+    # runs' per-step records is 1..n_train, each exactly once — run 2
+    # replayed none of run 1's samples and skipped none of its own
+    steps = _train_steps(metrics)
+    assert steps == list(range(1, n_train + 1)), (
+        f"step sequence has gaps/repeats around the kill point: "
+        f"{steps[max(0, saved_step - 3):saved_step + 3]}")
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    assert len(epochs) == 1 and int(epochs[0]["epoch"]) == 1

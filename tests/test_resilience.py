@@ -1,0 +1,719 @@
+"""Fault-tolerance subsystem tests (p2p_tpu.resilience).
+
+Unit level: retry/backoff classification + deadline + jitter bounds, chaos
+spec parsing + targeted/probabilistic/capped injection, preemption guard
+install/flag/flush-hook semantics, bounded queue shedding + deadlines +
+backoff re-entry, quarantine moves, atomic serve writes, checkpoint-seam
+retry under injected faults.
+
+Integration level (the acceptance pin): a training run preempted
+MID-EPOCH and resumed ends with a TrainState bitwise-equal to an
+uninterrupted run, with exact sample accounting on the fallback loader —
+zero replayed, zero skipped.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from p2p_tpu.resilience import (
+    BoundedRequestQueue,
+    ChaosMonkey,
+    FaultInjected,
+    PreemptionGuard,
+    Quarantine,
+    RetryPolicy,
+    install_chaos,
+    parse_spec,
+    retry_call,
+)
+from p2p_tpu.resilience.chaos import chaos_point
+from p2p_tpu.obs import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos():
+    """Each test starts and ends disarmed (chaos state is process-global)."""
+    install_chaos(None)
+    yield
+    install_chaos(None)
+
+
+# ---------------------------------------------------------------- retry
+
+
+def test_retry_recovers_from_transient_faults():
+    calls = []
+    delays = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("blip")
+        return 42
+
+    reg = MetricsRegistry()
+    assert retry_call(flaky, seam="t", registry=reg,
+                      sleep=delays.append) == 42
+    assert len(calls) == 3 and len(delays) == 2
+    assert reg.counter("retry_attempts_total", seam="t").value == 2
+    assert reg.counter("retry_exhausted_total", seam="t").value == 0
+
+
+def test_retry_nonretryable_raises_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("programming error")
+
+    with pytest.raises(ValueError):
+        retry_call(bad, seam="t", registry=MetricsRegistry(),
+                   sleep=lambda _: None)
+    assert len(calls) == 1  # never retried
+
+
+def test_retry_exhausts_attempts_and_counts():
+    reg = MetricsRegistry()
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always, policy=RetryPolicy(max_attempts=3), seam="t",
+                   registry=reg, sleep=lambda _: None)
+    assert len(calls) == 3
+    assert reg.counter("retry_exhausted_total", seam="t").value == 1
+    assert reg.counter("retry_attempts_total", seam="t").value == 2
+
+
+def test_retry_deadline_stops_early():
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        return clock["t"]
+
+    def fake_sleep(d):
+        clock["t"] += d
+
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("down")
+
+    # backoff 1, 2, 4... with a 2.5 s deadline: the 1 s retry fits, the
+    # next (cumulative 1+2=3 > 2.5) must not be attempted
+    with pytest.raises(OSError):
+        retry_call(
+            always,
+            policy=RetryPolicy(max_attempts=10, base_delay=1.0,
+                               max_delay=100.0, jitter=False, deadline=2.5),
+            seam="t", registry=MetricsRegistry(),
+            sleep=fake_sleep, clock=fake_clock,
+        )
+    assert len(calls) == 2
+
+
+def test_backoff_shape_and_jitter_bounds():
+    p = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=False)
+    assert p.backoff(1) == pytest.approx(0.1)
+    assert p.backoff(2) == pytest.approx(0.2)
+    assert p.backoff(3) == pytest.approx(0.4)
+    assert p.backoff(10) == pytest.approx(1.0)  # capped
+    import random
+
+    pj = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=True)
+    rng = random.Random(0)
+    for attempt in (1, 2, 5):
+        raw = min(1.0, 0.1 * 2 ** (attempt - 1))
+        for _ in range(50):
+            d = pj.backoff(attempt, rng)
+            assert raw / 2 <= d <= raw  # full-jitter band, never zero
+
+
+def test_fault_injected_is_retryable():
+    assert RetryPolicy().is_retryable(FaultInjected("x"))
+    assert RetryPolicy().is_retryable(OSError())
+    assert not RetryPolicy().is_retryable(KeyError())
+
+
+# ---------------------------------------------------------------- chaos
+
+
+def test_parse_spec_grammar():
+    s = parse_spec("ckpt_save:0.5x3, decode@7, serve_write, d2:0.25")
+    assert s["ckpt_save"].prob == 0.5 and s["ckpt_save"].max_faults == 3
+    assert s["decode"].at_step == 7 and s["decode"].max_faults == 1
+    assert s["serve_write"].prob == 1.0 and s["serve_write"].max_faults == 1
+    assert s["d2"].prob == 0.25 and s["d2"].max_faults is None
+    with pytest.raises(ValueError):
+        parse_spec("decode:1.5")  # probability out of range
+    with pytest.raises(ValueError):
+        parse_spec("")
+
+
+def test_chaos_targeted_step_fires_once():
+    m = ChaosMonkey.from_spec("decode@3", registry=MetricsRegistry())
+    m.maybe_fail("decode", step=2)          # wrong step: no fault
+    with pytest.raises(FaultInjected):
+        m.maybe_fail("decode", step=3)
+    m.maybe_fail("decode", step=3)          # capped at 1
+    assert m.counts() == {"decode": 1}
+
+
+def test_chaos_probabilistic_and_capped():
+    reg = MetricsRegistry()
+    m = ChaosMonkey.from_spec("decode:1.0x2", registry=reg)
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            m.maybe_fail("decode")
+    m.maybe_fail("decode")  # cap reached
+    assert reg.counter("chaos_injected_total", seam="decode").value == 2
+    m.maybe_fail("other_seam")  # unarmed seam: never fails
+
+
+def test_chaos_point_global_install():
+    chaos_point("decode")  # disarmed: no-op
+    install_chaos(ChaosMonkey.from_spec("decode", registry=MetricsRegistry()))
+    with pytest.raises(FaultInjected):
+        chaos_point("decode")
+    chaos_point("ckpt_save")  # other seams stay clean
+    install_chaos(None)
+    chaos_point("decode")  # disarmed again
+
+
+def test_chaos_env_activation(monkeypatch):
+    import p2p_tpu.resilience.chaos as chaos_mod
+
+    monkeypatch.setenv("P2P_CHAOS", "decode:1.0x1")
+    install_chaos(None)              # resets the env latch
+    with pytest.raises(FaultInjected):
+        chaos_point("decode")
+    chaos_point("decode")            # cap consumed
+
+
+# -------------------------------------------------------------- preempt
+
+
+def test_guard_flag_and_should_stop():
+    g = PreemptionGuard(registry=MetricsRegistry())
+    assert not g.requested and not g.should_stop()
+    g.request()
+    assert g.requested and g.should_stop()
+
+
+def test_guard_real_signal_sets_flag_and_flushes():
+    import time
+
+    reg = MetricsRegistry()
+    flushed = []
+    g = PreemptionGuard(registry=reg)
+    g.add_flush_hook(lambda: flushed.append(1))
+    prev = signal.getsignal(signal.SIGTERM)
+    with g:
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython delivers on the next bytecode boundary; the FLAG is set
+        # synchronously in the handler...
+        assert g.requested
+        assert g.signum == signal.SIGTERM
+        # ...while counter + flush hooks run on a helper thread (the
+        # handler must never touch locks the interrupted main thread may
+        # hold) — wait for it
+        deadline = time.monotonic() + 5.0
+        while not flushed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert flushed == [1]
+        assert reg.counter("preemptions_total", signal="SIGTERM").value == 1
+    # uninstall restored the previous handler
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_guard_install_uninstall_idempotent():
+    g = PreemptionGuard(registry=MetricsRegistry())
+    g.install()
+    g.install()
+    g.uninstall()
+    g.uninstall()
+
+
+# ---------------------------------------------------------------- queue
+
+
+def _fake_clock():
+    state = {"t": 0.0}
+
+    def clock():
+        return state["t"]
+
+    clock.advance = lambda d: state.__setitem__("t", state["t"] + d)
+    return clock
+
+
+def test_queue_sheds_when_full():
+    reg = MetricsRegistry()
+    q = BoundedRequestQueue(2, registry=reg)
+    assert q.offer("a") and q.offer("b")
+    assert not q.offer("c")  # shed
+    assert q.shed_count == 1
+    ready, expired = q.take(10)
+    assert [r.name for r in ready] == ["a", "b"] and not expired
+    assert reg.gauge("serve_queue_depth").value == 0
+
+
+def test_queue_deadline_expiry():
+    clock = _fake_clock()
+    q = BoundedRequestQueue(10, deadline_s=5.0, registry=MetricsRegistry(),
+                            clock=clock)
+    q.offer("old")
+    clock.advance(6.0)
+    q.offer("young")
+    ready, expired = q.take(10)
+    assert [r.name for r in ready] == ["young"]
+    assert [r.name for r in expired] == ["old"]
+    assert q.expired_count == 1
+
+
+def test_queue_requeue_backoff_window():
+    clock = _fake_clock()
+    q = BoundedRequestQueue(10, registry=MetricsRegistry(), clock=clock)
+    q.offer("a")
+    q.offer("b")
+    ready, _ = q.take(1)
+    req = ready[0]
+    req.attempts += 1
+    assert q.requeue(req, delay_s=10.0)
+    # inside the backoff window: 'a' is held back, 'b' dispatches
+    ready, _ = q.take(10)
+    assert [r.name for r in ready] == ["b"]
+    assert len(q) == 1
+    clock.advance(11.0)
+    ready, _ = q.take(10)
+    assert [r.name for r in ready] == ["a"] and req.attempts == 1
+
+
+def test_queue_requeue_keeps_original_deadline():
+    clock = _fake_clock()
+    q = BoundedRequestQueue(10, deadline_s=5.0,
+                            registry=MetricsRegistry(), clock=clock)
+    q.offer("a")
+    ready, _ = q.take(1)
+    clock.advance(3.0)
+    q.requeue(ready[0], delay_s=0.0)
+    clock.advance(3.0)  # 6s total in system > 5s deadline
+    ready, expired = q.take(1)
+    assert not ready and [r.name for r in expired] == ["a"]
+
+
+def test_quarantine_moves_file(tmp_path):
+    reg = MetricsRegistry()
+    src = tmp_path / "in" / "bad.png"
+    src.parent.mkdir()
+    src.write_bytes(b"not a png")
+    qdir = tmp_path / "in" / "failed"
+    quar = Quarantine(str(qdir), registry=reg)
+    dest = quar.quarantine(str(src), reason="decode exploded")
+    assert dest == str(qdir / "bad.png")
+    assert not src.exists() and os.path.exists(dest)
+    assert "decode exploded" in open(dest + ".reason.txt").read()
+    assert quar.count == 1
+    # missing file: returns None, never raises into the serve loop
+    assert quar.quarantine(str(src)) is None
+
+
+# ------------------------------------------------------- serve io (atomic)
+
+
+def test_atomic_write_leaves_no_tmp_and_retries(tmp_path, monkeypatch):
+    from p2p_tpu.serve.io import AsyncImageWriter
+
+    install_chaos(ChaosMonkey.from_spec("serve_write:1.0x1",
+                                        registry=MetricsRegistry()))
+    img = np.zeros((4, 4, 4, 3), np.float32)
+    paths = [str(tmp_path / f"{i}.png") for i in range(4)]
+    w = AsyncImageWriter(2)
+    w.submit_batch(img, paths)
+    assert w.drain() == 4  # the injected write fault was retried, not fatal
+    w.close()
+    for p in paths:
+        assert os.path.exists(p)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_atomic_write_cleans_tmp_on_permanent_failure(tmp_path, monkeypatch):
+    import p2p_tpu.serve.io as sio
+
+    def boom(arr, path):
+        with open(path, "w") as f:
+            f.write("partial")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(sio, "save_img", boom)
+    with pytest.raises(OSError):
+        sio.save_img_atomic(np.zeros((2, 2, 3), np.float32),
+                            str(tmp_path / "x.png"))
+    assert os.listdir(tmp_path) == []  # no torn tmp, no torn final
+
+
+# ------------------------------------------------- checkpoint seam wiring
+
+
+def test_checkpoint_save_restore_survive_injected_faults(tmp_path):
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    reg = MetricsRegistry()
+    install_chaos(ChaosMonkey.from_spec("ckpt_save:1.0x1,ckpt_restore:1.0x1",
+                                        registry=reg))
+    m = CheckpointManager(str(tmp_path / "ck"))
+    state = {"a": jnp.arange(4.0), "b": jnp.ones((2, 2))}
+    m.save(7, state, wait=True)           # first try injected, retry lands
+    restored = m.restore(state, 7)        # same on the restore seam
+    assert np.array_equal(np.asarray(restored["a"]), np.arange(4.0))
+    assert reg.counter("chaos_injected_total", seam="ckpt_save").value == 1
+    assert reg.counter("chaos_injected_total", seam="ckpt_restore").value == 1
+    m.close()
+
+
+def test_checkpoint_aux_sidecar_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    m = CheckpointManager(str(tmp_path / "ck"))
+    payload = {"step": 6, "epoch": 2, "batches_done": 2,
+               "steps_per_epoch": 4, "aug_seed": 2}
+    m.save_aux(6, payload)
+    assert m.restore_aux(6) == payload
+    assert m.restore_aux(99) is None
+    # the sidecar dir must not confuse orbax's step scan
+    m.save(6, {"a": jnp.zeros(2)}, wait=True)
+    assert m.latest_step() == 6
+    # torn sidecar: unreadable JSON degrades to None, not a crash
+    aux_path = str(tmp_path / "ck.aux" / "6.json")
+    with open(aux_path, "w") as f:
+        f.write("{torn")
+    assert m.restore_aux(6) is None
+    m.close()
+
+
+# ------------------------------------------ fallback loader: skip + warn
+
+
+def _tiny_ds(tmp_path, n=8):
+    from p2p_tpu.data.pipeline import PairedImageDataset
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+
+    root = make_synthetic_dataset(str(tmp_path / "d"), n, 2, size=16)
+    return PairedImageDataset(root, "train", image_size=16)
+
+
+def test_fallback_skip_batches_exact(tmp_path, monkeypatch):
+    from p2p_tpu.data.pipeline import make_loader
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    ds = _tiny_ds(tmp_path)
+    full = [b["input"].copy() for b in
+            make_loader(ds, 2, shuffle=True, seed=5, num_epochs=1)]
+    skip2 = [b["input"].copy() for b in
+             make_loader(ds, 2, shuffle=True, seed=5, num_epochs=1,
+                         skip_batches=2)]
+    assert len(skip2) == len(full) - 2
+    for a, b in zip(full[2:], skip2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fallback_skip_applies_to_first_epoch_only(tmp_path, monkeypatch):
+    from p2p_tpu.data.pipeline import make_loader
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    ds = _tiny_ds(tmp_path)
+    two = list(make_loader(ds, 2, shuffle=True, seed=5, num_epochs=2))
+    resumed = list(make_loader(ds, 2, shuffle=True, seed=5, num_epochs=2,
+                               skip_batches=3))
+    # epoch 1 contributes (4-3) batches, epoch 2 all 4
+    assert len(resumed) == len(two) - 3
+
+
+def test_grain_loader_skip_batches(tmp_path):
+    pytest.importorskip("grain")
+    from p2p_tpu.data.pipeline import make_loader
+
+    ds = _tiny_ds(tmp_path)
+    full = [b["input"].copy() for b in
+            make_loader(ds, 2, shuffle=True, seed=5, num_epochs=1)]
+    skip1 = [b["input"].copy() for b in
+             make_loader(ds, 2, shuffle=True, seed=5, num_epochs=1,
+                         skip_batches=1)]
+    assert len(skip1) == len(full) - 1
+    for a, b in zip(full[1:], skip1):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fallback_warns_workers_ignored_once(tmp_path, monkeypatch, capsys):
+    import p2p_tpu.data.pipeline as pl
+    from p2p_tpu.obs import get_registry
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    monkeypatch.setattr(pl, "_WORKERS_WARNED", False)
+    ds = _tiny_ds(tmp_path, n=4)
+    before = get_registry().counter("fallback_loader_workers_ignored").value
+    list(pl.make_loader(ds, 2, num_workers=4, num_epochs=1))
+    list(pl.make_loader(ds, 2, num_workers=4, num_epochs=1))  # warn ONCE
+    err = capsys.readouterr().err
+    assert err.count("num_workers=4 is ignored") == 1
+    after = get_registry().counter("fallback_loader_workers_ignored").value
+    assert after - before == 1
+
+
+# ----------------------------- the acceptance pin: exact-step kill/resume
+
+
+def _resume_cfg():
+    from p2p_tpu.core.config import (
+        Config, DataConfig, LossConfig, ModelConfig, OptimConfig,
+        ParallelConfig, TrainConfig,
+    )
+    from p2p_tpu.core.mesh import MeshSpec
+
+    return Config(
+        name="exact",
+        model=ModelConfig(generator="unet", ngf=4, ndf=4, num_D=1,
+                          n_layers_D=2, use_spectral_norm=False,
+                          use_compression_net=False, use_dropout=True),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        optim=OptimConfig(niter=2, niter_decay=2),
+        data=DataConfig(batch_size=2, image_size=16, threads=0),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+        train=TrainConfig(nepoch=2, epoch_save=2, log_every=100,
+                          mixed_precision=False, seed=0,
+                          eval_every_epoch=False),
+    )
+
+
+class _StopAfter:
+    """Deterministic stand-in guard: 'preempt' at an exact step boundary."""
+
+    def __init__(self, n_steps):
+        self.calls = 0
+        self.n = n_steps
+        self.signum = signal.SIGTERM
+
+    def should_stop(self):
+        self.calls += 1
+        return self.calls >= self.n
+
+
+def test_mid_epoch_preempt_resume_bitwise_equal(tmp_path, monkeypatch):
+    """THE resilience pin: preempt 2 batches into epoch 2 (step 6 of 8),
+    resume, and the final TrainState is bitwise-equal to an uninterrupted
+    run — with the resumed loader consuming EXACTLY the unconsumed tail of
+    the interrupted epoch (no replayed, no skipped samples)."""
+    import jax
+
+    import p2p_tpu.data.pipeline as pl
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.resilience import Preempted
+    from p2p_tpu.train.loop import Trainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")  # the fallback-loader pin
+    root = make_synthetic_dataset(str(tmp_path / "data"), 8, 2, size=16)
+
+    access = []
+    orig = pl.PairedImageDataset.__getitem__
+
+    def recording(self, idx):
+        if "/train/" in self.a_dir.replace(os.sep, "/"):
+            access.append(int(idx.__index__()
+                              if hasattr(idx, "__index__") else idx))
+        return orig(self, idx)
+
+    monkeypatch.setattr(pl.PairedImageDataset, "__getitem__", recording)
+
+    # ---- run A: uninterrupted, 2 epochs of 4 steps
+    tra = Trainer(_resume_cfg(), data_root=root, workdir=str(tmp_path / "a"))
+    try:
+        tra.fit()
+    finally:
+        tra.close()
+    order_a, access[:] = list(access), []
+    state_a = jax.device_get(tra.state)
+
+    # ---- run B1: preempted at step 6 = 2 batches into epoch 2
+    wb = str(tmp_path / "b")
+    trb = Trainer(_resume_cfg(), data_root=root, workdir=wb)
+    trb.preempt = _StopAfter(6)
+    try:
+        with pytest.raises(Preempted) as pi:
+            trb.fit()
+    finally:
+        trb.close()
+    assert pi.value.step == 6
+    ck = os.path.join(wb, "checkpoint", "facades", "exact")
+    assert os.path.isdir(os.path.join(ck, "6"))
+    access[:] = []
+
+    # ---- run B2: resume, must re-enter epoch 2 at batch 2
+    trb2 = Trainer(_resume_cfg(), data_root=root, workdir=wb)
+    assert trb2.maybe_resume()
+    assert trb2.epoch == 2 and trb2._resume_skip == 2
+    try:
+        trb2.fit()
+    finally:
+        trb2.close()
+    order_b2 = list(access)
+    state_b = jax.device_get(trb2.state)
+
+    # exact sample accounting: run A's stream is [host-sample, epoch-1 x8,
+    # epoch-2 x8]; the resumed run must consume exactly epoch 2's
+    # unconsumed tail (skip 2 batches = 4 samples) — same indices, same
+    # order, nothing replayed, nothing skipped. (order_b2[0] is trainer
+    # B2's own host-batch template sample.)
+    epoch2_a = order_a[-8:]
+    assert order_b2[1:] == epoch2_a[4:], (order_b2, epoch2_a)
+
+    # bitwise-equal final state: every leaf, exact
+    leaves_a, td_a = jax.tree_util.tree_flatten(state_a)
+    leaves_b, td_b = jax.tree_util.tree_flatten(state_b)
+    assert td_a == td_b
+    for i, (a, b) in enumerate(zip(leaves_a, leaves_b)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"leaf {i} differs after kill/resume")
+
+
+def test_preempt_writes_sidecar_and_metrics_record(tmp_path, monkeypatch):
+    """The preemption epilogue: exact-step checkpoint + iterator sidecar +
+    a kind=preempt record in the (flushed) metrics stream."""
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.resilience import Preempted
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.loop import Trainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "data"), 8, 2, size=16)
+    wd = str(tmp_path / "w")
+    tr = Trainer(_resume_cfg(), data_root=root, workdir=wd)
+    tr.preempt = _StopAfter(3)
+    try:
+        with pytest.raises(Preempted):
+            tr.fit()
+    finally:
+        tr.close()
+    ck = CheckpointManager(os.path.join(wd, "checkpoint", "facades", "exact"))
+    aux = ck.restore_aux(3)
+    ck.close()
+    assert aux == {"step": 3, "epoch": 1, "batches_done": 3,
+                   "steps_per_epoch": 4, "aug_seed": 1}
+    kinds = [json.loads(line) for line in
+             open(os.path.join(wd, "metrics_exact.jsonl"))]
+    pre = [r for r in kinds if r.get("kind") == "preempt"]
+    assert pre and pre[0]["step"] == 3 and pre[0]["signum"] == signal.SIGTERM
+
+
+@pytest.mark.slow
+def test_video_mid_epoch_preempt_resume(tmp_path, monkeypatch):
+    """The video trainer shares the preemption protocol AND the exact-step
+    resume path: preempted mid-epoch, it must re-enter its epoch at the
+    exact next clip batch (skip derived from the sidecar) and finish with
+    continuous step accounting."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.core.mesh import MeshSpec
+    from p2p_tpu.data.video import make_synthetic_video_dataset
+    from p2p_tpu.resilience import Preempted
+    from p2p_tpu.train.video_loop import VideoTrainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = str(tmp_path / "vds")
+    # 4 videos x 8 frames, window 4, stride 4 -> 8 clips; bs=2 -> spe=4
+    make_synthetic_video_dataset(root, n_videos=4, n_frames=8, size=16)
+    base = get_preset("vid2vid_temporal")
+    cfg = base.replace(
+        model=dataclasses.replace(base.model, ngf=8, ndf=8, num_D=2,
+                                  n_layers_D=2),
+        data=dataclasses.replace(base.data, batch_size=2, test_batch_size=1,
+                                 image_size=16, n_frames=4),
+        loss=dataclasses.replace(base.loss, lambda_vgg=0.0),
+        parallel=dataclasses.replace(base.parallel, mesh=MeshSpec(data=1)),
+        train=dataclasses.replace(base.train, nepoch=2, epoch_save=2,
+                                  log_every=100, mixed_precision=False,
+                                  seed=0, eval_every_epoch=False),
+    )
+    wd = str(tmp_path / "w")
+    tr = VideoTrainer(cfg, data_root=root, workdir=wd, use_mesh=False)
+    spe = tr.steps_per_epoch
+    assert spe == 4
+    tr.preempt = _StopAfter(spe + 2)    # 2 batches into epoch 2
+    try:
+        with pytest.raises(Preempted) as pi:
+            tr.fit()
+    finally:
+        tr.close()
+    assert pi.value.step == spe + 2
+
+    tr2 = VideoTrainer(cfg, data_root=root, workdir=wd, use_mesh=False)
+    assert tr2.maybe_resume()
+    assert tr2.epoch == 2 and tr2._resume_skip == 2
+    try:
+        hist = tr2.fit()
+    finally:
+        tr2.close()
+    # the resumed epoch ran only its unconsumed tail, and the step counter
+    # ends exactly where an uninterrupted 2-epoch run would
+    assert int(tr2.state.step) == 2 * spe
+    assert [int(h["epoch"]) for h in hist] == [2]
+
+
+def test_chaos_targeted_call_count_without_step():
+    """seam@N at a step-less seam (decode, serve_write) targets the N-th
+    chaos-point hit — targeted injection works at every seam, not just the
+    checkpoint ones that report a train step."""
+    m = ChaosMonkey.from_spec("decode@3", registry=MetricsRegistry())
+    m.maybe_fail("decode")              # call 1
+    m.maybe_fail("decode")              # call 2
+    with pytest.raises(FaultInjected):
+        m.maybe_fail("decode")          # call 3: fires
+    m.maybe_fail("decode")              # capped at 1
+    assert m.counts() == {"decode": 1}
+
+
+def test_writer_tolerant_mode_survives_poison_path(tmp_path):
+    """fail_fast=False: a permanently-unwritable output path is recorded
+    in write_errors, the rest of the batch still lands, drain never
+    raises — the write-side analog of decode quarantine."""
+    from p2p_tpu.serve.io import AsyncImageWriter
+
+    img = np.zeros((3, 4, 4, 3), np.float32)
+    poison = tmp_path / "taken.png"
+    poison.mkdir()  # a directory squatting on the target name: IsADirectoryError
+    paths = [str(tmp_path / "a.png"), str(poison), str(tmp_path / "b.png")]
+    w = AsyncImageWriter(2, fail_fast=False)
+    w.submit_batch(img, paths)
+    assert w.drain() == 2               # the two good rows wrote
+    w.close()
+    assert os.path.exists(paths[0]) and os.path.exists(paths[2])
+    assert len(w.write_errors) == 1 and w.write_errors[0][0] == str(poison)
+
+    # default fail_fast=True keeps the loud contract (bench/offline)
+    w2 = AsyncImageWriter(2)
+    w2.submit_batch(img, paths)
+    with pytest.raises(OSError):
+        w2.drain()
+
+
+def test_registry_total_sums_counter_tag_variants():
+    reg = MetricsRegistry()
+    reg.counter("retry_attempts_total", seam="decode").inc(2)
+    reg.counter("retry_attempts_total", seam="serve_write").inc(3)
+    reg.counter("retry_attempts_total_other").inc(7)  # prefix must NOT match
+    reg.gauge("retry_attempts_total_gauge").set(99)
+    assert reg.total("retry_attempts_total") == 5
+    assert reg.total("missing") == 0

@@ -417,3 +417,111 @@ def test_infer_cli_video_round_trip(tmp_path):
     ])
     assert rc == 0
     assert len(os.listdir(tmp_path / "pred")) == 8  # 1 video × 8 frames
+
+
+# ------------------------------------------- serve hardening (resilience)
+@pytest.fixture()
+def fresh_registry():
+    """Serve-main counters report through the process default registry —
+    isolate each hardening test behind a fresh one."""
+    from p2p_tpu.obs import MetricsRegistry, set_registry
+    from p2p_tpu.resilience import install_chaos
+
+    prev = set_registry(MetricsRegistry())
+    yield
+    set_registry(prev)
+    install_chaos(None)  # a failed serve run must not leave chaos armed
+
+
+def _serve_summary(capsys):
+    import json
+
+    for line in reversed(capsys.readouterr().out.splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("kind") == "serve_summary":
+            return rec
+    raise AssertionError("no serve_summary line printed")
+
+
+def _hardening_setup(tmp_path, n_test=3):
+    import dataclasses
+
+    root = make_synthetic_dataset(str(tmp_path / "ds"), 0, n_test, size=16)
+    cfg = get_preset("facades")
+    cfg = dataclasses.replace(
+        cfg,
+        name="t",
+        model=dataclasses.replace(cfg.model, ngf=4),
+        data=dataclasses.replace(cfg.data, dataset="synth", image_size=16),
+    )
+    _save_facades_ckpt(str(tmp_path), cfg, synthetic_batch(1, 16, dtype="uint8"))
+    base = [
+        "--preset", "facades", "--dataset", "synth", "--name", "t",
+        "--image_size", "16", "--ngf", "4", "--workdir", str(tmp_path),
+        "--once", "--max_batch", "2", "--dtype", "f32",
+        "--retry_delay_ms", "20",
+    ]
+    return os.path.join(root, "test", "a"), base
+
+
+def test_serve_quarantines_poison_input(tmp_path, capsys, fresh_registry):
+    """A permanently-corrupt request is retried --max_attempts times, then
+    MOVED to the quarantine dir (with a reason breadcrumb) — never
+    re-enqueued forever, never fatal, and the valid requests all serve."""
+    from p2p_tpu.cli.serve import main as serve_main
+
+    in_dir, base = _hardening_setup(tmp_path)
+    with open(os.path.join(in_dir, "poison.png"), "wb") as f:
+        f.write(b"not a png")
+    rc = serve_main(base + ["--input_dir", in_dir,
+                            "--out", str(tmp_path / "served"),
+                            "--max_attempts", "2"])
+    assert rc == 0
+    summary = _serve_summary(capsys)
+    assert summary["served"] == 3 and summary["quarantined"] == 1
+    assert len(os.listdir(tmp_path / "served")) == 3
+    qdir = os.path.join(in_dir, "failed")
+    assert not os.path.exists(os.path.join(in_dir, "poison.png"))
+    assert os.path.exists(os.path.join(qdir, "poison.png"))
+    assert "failed decodes" in open(
+        os.path.join(qdir, "poison.png.reason.txt")).read()
+
+
+def test_serve_survives_injected_decode_faults(tmp_path, capsys,
+                                               fresh_registry):
+    """The acceptance pin: with decode chaos armed the server sheds
+    nothing, crashes never, retries the injected faults, and still serves
+    every request."""
+    from p2p_tpu.cli.serve import main as serve_main
+
+    in_dir, base = _hardening_setup(tmp_path)
+    rc = serve_main(base + ["--input_dir", in_dir,
+                            "--out", str(tmp_path / "served"),
+                            "--chaos", "decode:1.0x2"])
+    assert rc == 0
+    summary = _serve_summary(capsys)
+    assert summary["served"] == 3
+    assert summary["chaos_injected"] == 2   # both faults fired...
+    assert summary["quarantined"] == 0      # ...and were absorbed
+    assert len(os.listdir(tmp_path / "served")) == 3
+
+
+def test_serve_bounded_queue_sheds_overflow(tmp_path, capsys,
+                                            fresh_registry):
+    """--max_queue 2 with 3 requests: one arrival is shed (counted, file
+    left in place, never served) — bounded backlog under overload."""
+    from p2p_tpu.cli.serve import main as serve_main
+
+    in_dir, base = _hardening_setup(tmp_path)
+    rc = serve_main(base + ["--input_dir", in_dir,
+                            "--out", str(tmp_path / "served"),
+                            "--max_queue", "2"])
+    assert rc == 0
+    summary = _serve_summary(capsys)
+    assert summary["served"] == 2 and summary["shed"] == 1
+    assert len(os.listdir(tmp_path / "served")) == 2
+    assert len([f for f in os.listdir(in_dir)
+                if f.endswith(".png")]) == 3  # shed file untouched
