@@ -39,9 +39,9 @@ PNG output) on synthetic data, reported with the fenced breakdown
 ``--infer --dry-run`` is its CPU-able CI plumbing row.
 
 ``--chaos [SPEC]`` arms the fault-injection layer
-(p2p_tpu.resilience.chaos) for the run — default spec
-``serve_write:1.0x2`` makes the first two output writes fail (then the
-seam goes quiet), so the row measures throughput WITH the retry/recovery
+(p2p_tpu.resilience.chaos) for the run. With ``--infer`` (default spec
+``serve_write:1.0x2``) the first two output writes fail (then the seam
+goes quiet), so the row measures throughput WITH the retry/recovery
 machinery firing; ``chaos_injected``/``retries`` land in the record. The
 resilience contract this mode stands guard over: injected faults at the
 wrapped seams must cost retries, never correctness — the row must still
@@ -49,6 +49,15 @@ satisfy the bucket-compile contract and stay in band. (Probabilistic
 specs like ``serve_write:0.2`` measure sustained-fault throughput but CAN
 legitimately exhaust the 3-attempt retry budget on an unlucky streak —
 that's the give-up-eventually contract, not a bug.)
+
+``--chaos`` WITHOUT ``--infer`` (default spec ``nan@3x2``) is the
+standing SENTINEL row: the train headline with the divergence sentinel
+(p2p_tpu.resilience.health) classifying every step inside the timed
+region at the trainer's exact delayed-read cost model, and the ``nan``
+seam poisoning the targeted observations. The contract: the sentinel's
+healthy-path overhead stays within the BASELINE.md headline band (<1%) —
+``sentinel`` {steps, spikes, nonfinite} lands in the record as proof the
+path actually ran.
 """
 
 from __future__ import annotations
@@ -59,7 +68,7 @@ import os
 import sys
 
 
-def run_single(tiny: bool = False) -> dict:
+def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -228,14 +237,53 @@ def run_single(tiny: bool = False) -> dict:
         state, metrics = step(state, batches)
         float(metrics["loss_g"][-1])
 
+    # --chaos: exercise the divergence sentinel at the trainer's exact
+    # cost model — the PREVIOUS dispatch's per-step metrics are fetched
+    # and classified while the next one runs (train/loop.py's delayed
+    # read), INSIDE the timed region, so the row measures the healthy-
+    # path overhead the BASELINE.md band check stands guard over. The
+    # 'nan' chaos seam poisons observations here exactly like the loop.
+    sentinel = None
+    sentinel_stats = {"steps": 0, "spikes": 0, "nonfinite": 0}
+    if with_sentinel:
+        from p2p_tpu.resilience.health import (
+            DivergenceSentinel,
+            poison_nan_observation,
+        )
+
+        sentinel = DivergenceSentinel()
+
+        def sentinel_feed(metrics_dev):
+            host = jax.device_get(metrics_dev)
+            for i in range(scan_k):
+                sentinel_stats["steps"] += 1
+                # step = OBSERVED step count (1-based, warmup excluded):
+                # the default nan@3x2 spec targets the first fetched
+                # dispatch at every scan_k, not a train-step number that
+                # would shift past the range at BENCH_SCAN=8
+                m = poison_nan_observation(
+                    sentinel_stats["steps"],
+                    {k: float(v[i]) for k, v in host.items()})
+                status = sentinel.classify(m)
+                if status != "healthy":
+                    key = ("nonfinite" if status == "diverged" else "spikes")
+                    sentinel_stats[key] += 1
+
     # the chained fenced interval, minus RTT — StepTimer.chain is the
     # same accumulator the per-step tick() path feeds, so this number and
     # the train loop's are the one img/sec/chip definition
     timer = StepTimer(batch_size=bs * max(n_frames, 1))
     with span("bench_timed"), timer.chain(
             steps=scan_k * n_calls, rtt=rtt) as ch:
+        pend = None
         for _ in range(n_calls):
             state, metrics = step(state, batches)
+            if sentinel is not None:
+                if pend is not None:
+                    sentinel_feed(pend)
+                pend = metrics
+        if sentinel is not None and pend is not None:
+            sentinel_feed(pend)
         ch.fence(metrics["loss_g"][-1])  # forces the whole chained sequence
 
     img_per_sec = timer.images_per_sec
@@ -254,6 +302,8 @@ def run_single(tiny: bool = False) -> dict:
         "unit": "img/sec/chip",
         "vs_baseline": round(img_per_sec / baseline, 4) if comparable else 0.0,
     }
+    if sentinel is not None:
+        record["sentinel"] = dict(sentinel_stats)
     if comparable:
         # context: the 2000 img/s north star was set for TPU v4 (275 bf16
         # peak TF/s); this driver measures whatever chip the tunnel exposes.
@@ -474,21 +524,28 @@ def main(argv=None) -> int:
                     help="bench the serving engine instead of the train "
                          "step: AOT bucket-batched inference + pipelined "
                          "PNG output, fenced breakdown (docs/SERVING.md)")
-    ap.add_argument("--chaos", nargs="?", const="serve_write:1.0x2",
+    ap.add_argument("--chaos", nargs="?", const="__default__",
                     default=None, metavar="SPEC",
-                    help="arm fault injection for the run (default spec "
-                         "'serve_write:1.0x2'): the row measures "
-                         "throughput with retries firing — the resilience "
-                         "overhead number (docs/RESILIENCE.md)")
+                    help="arm fault injection for the run. With --infer "
+                         "(default spec 'serve_write:1.0x2') the row "
+                         "measures throughput with retries firing; alone "
+                         "(default spec 'nan@3x2') it runs the TRAIN "
+                         "headline with the divergence sentinel classifying "
+                         "every step at the trainer's delayed-read cost "
+                         "model — the standing sentinel-overhead row "
+                         "(docs/RESILIENCE.md)")
     ap.add_argument("--dry-run", action="store_true",
-                    help="with --sweep/--infer: toy dims, plumbing check "
-                         "only (CPU-able; no band comparison)")
+                    help="with --sweep/--infer/--chaos: toy dims, plumbing "
+                         "check only (CPU-able; no band comparison)")
     args = ap.parse_args(argv)
     chaos_counts = None
     if args.chaos:
         from p2p_tpu.resilience import ChaosMonkey, install_chaos
 
-        monkey = ChaosMonkey.from_spec(args.chaos)
+        spec = args.chaos
+        if spec == "__default__":
+            spec = "serve_write:1.0x2" if args.infer else "nan@3x2"
+        monkey = ChaosMonkey.from_spec(spec)
         install_chaos(monkey)
         chaos_counts = monkey.counts
     if args.infer:
@@ -503,7 +560,13 @@ def main(argv=None) -> int:
         return 0
     if args.sweep:
         return run_sweep(dry_run=args.dry_run)
-    print(json.dumps(run_single()))
+    # plain train row; --chaos additionally runs the sentinel at the
+    # trainer's cost model and reports what it classified/injected
+    rec = run_single(tiny=args.dry_run and chaos_counts is not None,
+                     with_sentinel=chaos_counts is not None)
+    if chaos_counts is not None:
+        rec["chaos_injected"] = chaos_counts()
+    print(json.dumps(rec))
     return 0
 
 

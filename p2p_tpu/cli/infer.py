@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["deconv", "resize"])
     p.add_argument("--metrics", action="store_true",
                    help="also print mean/max PSNR+SSIM vs the targets")
+    p.add_argument("--ema_decay", type=float, default=None,
+                   help="the checkpoint was trained with --ema_decay: "
+                        "restore the EMA generator weights too and serve "
+                        "the SMOOTHED G (bitwise == raw at decay 0)")
     p.add_argument("--pool_size", type=int, default=None,
                    help="image presets: accepted-but-ignored (params-only "
                         "restore never rebuilds the fake pool); video "
@@ -123,7 +127,8 @@ def main(argv=None) -> int:
                 test_batch_size=args.batch_size, image_size=args.image_size)
     model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks,
                  upsample_mode=args.upsample_mode)
-    cfg = dataclasses.replace(cfg, data=data, model=model,
+    health = over(cfg.health, ema_decay=args.ema_decay)
+    cfg = dataclasses.replace(cfg, data=data, model=model, health=health,
                               name=args.name or cfg.name)
     if cfg.data.n_frames > 1:
         # the video path restores the FULL TrainState (its own pytree), so

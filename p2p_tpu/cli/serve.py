@@ -71,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "two up to --max_batch)")
     p.add_argument("--dtype", type=str, default="bf16",
                    choices=["bf16", "f32"])
+    p.add_argument("--ema_decay", type=float, default=None,
+                   help="the checkpoint was trained with --ema_decay: "
+                        "restore the EMA generator weights and serve the "
+                        "SMOOTHED G (bitwise == raw at decay 0)")
     p.add_argument("--mesh", type=str, default=None,
                    help="serving mesh 'data,spatial,time[,model]'")
     p.add_argument("--tp_min_ch", type=int, default=None)
@@ -133,7 +137,8 @@ def main(argv=None) -> int:
         return 2
     data = over(cfg.data, dataset=args.dataset, image_size=args.image_size)
     model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks)
-    cfg = dataclasses.replace(cfg, data=data, model=model,
+    health = over(cfg.health, ema_decay=args.ema_decay)
+    cfg = dataclasses.replace(cfg, data=data, model=model, health=health,
                               name=args.name or cfg.name)
 
     h, w = cfg.image_hw

@@ -82,6 +82,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "programs from disk instead of recompiling; "
                         "hits/misses are counted through the obs retrace "
                         "watchdog")
+    # --- self-healing knobs (p2p_tpu.resilience.health) -------------------
+    p.add_argument("--health", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="divergence sentinel + recovery ladder (skip -> "
+                        "LR cooldown -> rollback to the last-good "
+                        "checkpoint; docs/RESILIENCE.md). On by default; "
+                        "--no-health disables both the sentinel and the "
+                        "in-step skip guard")
+    p.add_argument("--ema_decay", type=float, default=None,
+                   help="EMA generator decay (e.g. 0.999): TrainState "
+                        "carries smoothed G weights, eval/serve use them "
+                        "(0 = EMA tracks raw params exactly — the parity "
+                        "mode; unset = off)")
+    p.add_argument("--max_rollbacks", type=int, default=None,
+                   help="rollbacks to the last-good checkpoint before the "
+                        "run gives up with exit code 76 (default 3)")
+    p.add_argument("--spike_zscore", type=float, default=None,
+                   help="robust z-score over the loss window above which "
+                        "a step classifies as a spike (default 6.0)")
+    p.add_argument("--cooldown_steps", type=int, default=None,
+                   help="steps the ladder's LR cooldown (rung 2) holds "
+                        "the reduced LR before restoring (default 20)")
+    p.add_argument("--health_window", type=int, default=None,
+                   help="healthy steps in the sentinel's robust z-score "
+                        "window (default 32)")
     # --- telemetry / debug knobs (p2p_tpu.obs) ----------------------------
     p.add_argument("--check_finite", action="store_true", default=None,
                    help="host-side non-finite guard on the step metrics "
@@ -228,6 +253,12 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  compilation_cache_dir=args.compilation_cache)
     debug = over(cfg.debug, check_finite=args.check_finite,
                  nan_sentinel=args.nan_sentinel, grad_norms=args.grad_norms)
+    health = over(cfg.health, enabled=args.health,
+                  ema_decay=args.ema_decay,
+                  max_rollbacks=args.max_rollbacks,
+                  spike_zscore=args.spike_zscore,
+                  cooldown_steps=args.cooldown_steps,
+                  window=args.health_window)
     par = over(par, tp_min_ch=args.tp_min_ch)
     if args.mesh is not None:
         from p2p_tpu.core.mesh import MeshSpec
@@ -254,7 +285,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     name = args.name or cfg.name
     cfg = dataclasses.replace(
         cfg, name=name, model=model, loss=loss, optim=optim, data=data,
-        train=train, parallel=par, debug=debug,
+        train=train, parallel=par, debug=debug, health=health,
     )
     if getattr(args, "phase", None) == "global":
         # coarse-to-fine phase 1 — applied AFTER flag overrides so an
@@ -307,7 +338,12 @@ def main(argv=None) -> int:
             trainer.state, cfg, workdir=args.workdir,
             g1_dir=args.init_g1_from, mesh=getattr(trainer, "mesh", None),
         )
-    from p2p_tpu.resilience import PREEMPTED_EXIT_CODE, Preempted
+    from p2p_tpu.resilience import (
+        DIVERGED_EXIT_CODE,
+        PREEMPTED_EXIT_CODE,
+        DivergenceError,
+        Preempted,
+    )
 
     try:
         trainer.fit()
@@ -319,6 +355,14 @@ def main(argv=None) -> int:
               f"relaunch with identical flags to resume "
               f"(exit {PREEMPTED_EXIT_CODE})", flush=True)
         return PREEMPTED_EXIT_CODE
+    except DivergenceError as d:
+        # the recovery ladder is exhausted: rolled back max_rollbacks
+        # times and diverged again. Exit 76 — DISTINCT from preemption's
+        # 75, because "relaunch with identical flags" would just diverge
+        # again; this needs a human (or a config change).
+        print(f"diverged: {d} (exit {DIVERGED_EXIT_CODE})", flush=True)
+        trainer.logger.registry.flush()
+        return DIVERGED_EXIT_CODE
     finally:
         trainer.close()  # unhook compile listener + sentinel handler
     return 0

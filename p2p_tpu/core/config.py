@@ -258,6 +258,40 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Self-healing training (p2p_tpu.resilience.health): divergence
+    sentinel -> recovery ladder -> last-good rollback, plus the EMA
+    generator. ``enabled`` default True: the sentinel consumes metrics the
+    loop already computes (one delayed small D2H per dispatch) and the
+    in-jit skip guard folds into the existing update-scale multiply —
+    measured-in-band on the healthy path (bench.py --chaos)."""
+
+    enabled: bool = True
+    # Sentinel: robust z-score over the last `window` HEALTHY steps per
+    # watched loss (G/D/C + grad norms when tapped); a step is a SPIKE
+    # when |z| > spike_zscore, DIVERGED when any watched value is
+    # non-finite. The EWMA (alpha) smooths the reference level the
+    # z-score recenters on.
+    window: int = 32
+    spike_zscore: float = 6.0
+    ewma_alpha: float = 0.1
+    # Ladder rung 2: scale the (G/D/C) LR by cooldown_factor for
+    # cooldown_steps observed steps, then restore.
+    cooldown_steps: int = 20
+    cooldown_factor: float = 0.1
+    # Ladder rung 3: rollbacks to the last-good checkpoint before the run
+    # gives up with DIVERGED_EXIT_CODE (76).
+    max_rollbacks: int = 3
+    # A healthy streak this long resets the ladder to rung 0.
+    reset_after: int = 16
+    # EMA generator params (ProGAN-lineage stabilization): None = off
+    # (TrainState.ema_g stays None — old checkpoints restore bit-for-bit);
+    # 0.0 = EMA tracks params exactly (the parity-pin mode); 0.999 = the
+    # classic smoothing. Eval and serving use the EMA weights when present.
+    ema_decay: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class DebugConfig:
     """Numerical/telemetry debug taps (p2p_tpu.obs; all off by default —
     the happy path pays nothing)."""
@@ -286,6 +320,7 @@ class Config:
     parallel: ParallelConfig = ParallelConfig()
     train: TrainConfig = TrainConfig()
     debug: DebugConfig = DebugConfig()
+    health: HealthConfig = HealthConfig()
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)

@@ -17,11 +17,19 @@ an afterthought per call site. Four pillars:
   recovery paths on purpose.
 - :mod:`.queue` — serve hardening: bounded request queue with load
   shedding, per-request deadlines, poison-input quarantine.
+- :mod:`.health` — self-healing training: divergence sentinel (EWMA +
+  robust z-score over the step losses) → bounded recovery ladder (skip →
+  LR cooldown → rollback to the last eval-validated checkpoint) →
+  :data:`~p2p_tpu.resilience.health.DIVERGED_EXIT_CODE` (76) when the
+  ladder is exhausted; plus checkpoint integrity verification and the
+  EMA generator (train/checkpoint.py, train/step.py).
 
 Everything counts through the PR-1 obs registry: ``preemptions_total``,
 ``retry_attempts_total``/``retry_exhausted_total``,
 ``chaos_injected_total``, ``serve_shed_total``,
-``serve_deadline_expired_total``, ``serve_quarantined_total``.
+``serve_deadline_expired_total``, ``serve_quarantined_total``,
+``health_spikes_total``/``health_skips_total``/``health_cooldowns_total``/
+``health_rollbacks_total``, ``ckpt_corrupt_total``.
 """
 
 from p2p_tpu.resilience.chaos import (
@@ -31,6 +39,13 @@ from p2p_tpu.resilience.chaos import (
     get_chaos,
     install as install_chaos,
     parse_spec,
+)
+from p2p_tpu.resilience.health import (
+    DIVERGED_EXIT_CODE,
+    DivergenceError,
+    DivergenceSentinel,
+    RecoveryLadder,
+    TrainingHealth,
 )
 from p2p_tpu.resilience.preempt import (
     PREEMPTED_EXIT_CODE,
@@ -51,7 +66,12 @@ __all__ = [
     "CKPT_POLICY",
     "ChaosMonkey",
     "DEFAULT_POLICY",
+    "DIVERGED_EXIT_CODE",
+    "DivergenceError",
+    "DivergenceSentinel",
     "FaultInjected",
+    "RecoveryLadder",
+    "TrainingHealth",
     "PREEMPTED_EXIT_CODE",
     "Preempted",
     "PreemptionGuard",

@@ -14,16 +14,24 @@ Spec grammar (comma-separated entries)::
     ckpt_save:0.5        fail seam 'ckpt_save' with probability 0.5
     decode@7             fail seam 'decode' exactly at "step" 7
     ckpt_save:0.5x3      as above, but at most 3 injected faults total
+    nan@50x3             fail seam 'nan' at steps 50, 51 and 52
     decode:0.2x1,ckpt_save@12
 
 ``seam@N`` compares against the step the seam reports (checkpoint seams
 pass the train step); seams with no step concept (decode, serve_write)
 fall back to their OWN call count, so ``decode@7`` means "the 7th decode
-of this process" — targeted injection works at every seam.
+of this process" — targeted injection works at every seam. A step-
+targeted entry's ``xM`` cap widens the target to the RANGE [N, N+M):
+``nan@50x3`` fires at steps 50..52 — the shape the recovery-ladder
+rehearsals need (one injection per rung). Repeated calls at the same
+step (a retry loop) still consume the cap one fault at a time.
 
 Seam names in use: ``ckpt_save``, ``ckpt_restore``, ``decode``,
-``serve_write``. Unknown names are legal (a chaos point is just a string),
-so new seams need no registry changes.
+``serve_write``, ``nan`` (train-loop loss poisoning — the divergence
+sentinel's rehearsal hook, train/loop.py), ``ckpt_corrupt`` (simulated
+checksum mismatch at restore-verify, train/checkpoint.py). Unknown names
+are legal (a chaos point is just a string), so new seams need no
+registry changes.
 
 Every injected fault raises :class:`FaultInjected` (classified retryable
 by the default :class:`~p2p_tpu.resilience.retry.RetryPolicy`) and bumps
@@ -141,9 +149,13 @@ class ChaosMonkey:
                 return
             if s.at_step is not None:
                 # seams that report no step (decode, serve_write) target
-                # by their own call count, so seam@N works everywhere
+                # by their own call count, so seam@N works everywhere;
+                # the xM cap widens the target to the range [N, N+M) —
+                # one injection per step for ladder rehearsals (same-step
+                # retries still drain the cap fault by fault)
                 at = step if step is not None else s.calls
-                if at != s.at_step:
+                span = s.max_faults if s.max_faults is not None else 1
+                if not (s.at_step <= at < s.at_step + span):
                     return
             elif not (s.prob > 0.0 and self._rng.random() < s.prob):
                 return

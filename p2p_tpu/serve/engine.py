@@ -329,4 +329,9 @@ def engine_from_checkpoint(
         state = mgr.restore_subtree(template, step)
     finally:
         mgr.close()
+    if jax.tree_util.tree_leaves(state.ema_g):
+        # EMA-trained checkpoint (HealthConfig.ema_decay, requested via
+        # the CLI's --ema_decay): serve the SMOOTHED generator — the
+        # ProGAN-lineage quality lever. Pinned bitwise == raw at decay=0.
+        state = state.replace(params_g=state.ema_g, ema_g=None)
     return InferenceEngine(cfg, state, **engine_kw), int(step)

@@ -23,24 +23,83 @@ a mid-epoch checkpoint resumable to the EXACT sample (train/loop.py
 maybe_resume). The sidecar lives in a SIBLING ``<dir>.aux/`` directory:
 Orbax owns the checkpoint directory's layout, and a foreign subdir there
 would trip its step scan.
+
+Integrity + last-good (the self-healing subsystem, resilience/health.py):
+every save records a per-array CRC32 manifest (``<step>.integrity.json``
+in the aux dir); :meth:`restore` verifies the restored leaves against it
+and, when the requested step is corrupt (torn upload, truncated array,
+bit rot — or the ``ckpt_corrupt`` chaos seam), transparently falls back
+to the newest INTACT older step instead of crashing. A directory with no
+intact step raises :class:`CheckpointCorrupt` — deliberately NOT in the
+retry layer's transient class: re-reading rotten bytes forever is the
+failure mode this error exists to prevent. :meth:`mark_good` /
+:meth:`last_good_step` track the newest *eval-validated* step — the
+recovery ladder's rollback target.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
-from p2p_tpu.resilience.chaos import chaos_point
+from p2p_tpu.resilience.chaos import FaultInjected, chaos_point
 from p2p_tpu.resilience.retry import CKPT_POLICY, retry_call
 from p2p_tpu.train.state import TrainState
 
 
+class CheckpointCorrupt(RuntimeError):
+    """No intact checkpoint could be restored (checksum mismatches or
+    unreadable steps all the way down). Classified NON-retryable by
+    design: the retry layer handles transient faults, and corrupt bytes
+    on disk do not heal with backoff."""
+
+    def __init__(self, directory: str, tried: List[int],
+                 last_error: Optional[BaseException] = None):
+        self.directory = directory
+        self.tried = list(tried)
+        # surface the underlying failure in the message itself: when every
+        # step fails the SAME way (e.g. a template/shape mismatch from a
+        # wrong CLI flag) the cause is the diagnosis, not disk rot
+        cause = f"; last error: {last_error!r}" if last_error else ""
+        super().__init__(
+            f"no intact checkpoint under {directory} "
+            f"(tried steps {tried}){cause}; if every step failed "
+            "identically, check the restore template/flags before "
+            "suspecting corruption")
+
+
 def _abstract(leaf):
     return ocp.utils.to_shape_dtype_struct(leaf)
+
+
+def _leaf_checksums(tree: Any) -> Optional[Dict[str, Dict[str, Any]]]:
+    """``{leaf_path: {crc32, shape, dtype}}`` over a pytree's arrays.
+
+    CRC32 (zlib — fast, and torn/truncated/bit-rotted arrays are the
+    threat model, not an adversary) over the host bytes of every leaf.
+    None on multi-process runs: a global array's rows are only partially
+    addressable per process, so a host-local checksum would not name a
+    well-defined value. (Single-process sharded states — CLI-TP — are
+    fully addressable and checksum fine.)
+    """
+    if jax.process_count() > 1:
+        return None
+    out: Dict[str, Dict[str, Any]] = {}
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        out[jax.tree_util.keystr(path)] = {
+            "crc32": zlib.crc32(arr.tobytes()),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+    return out
 
 
 def _restore_arg(abstract_leaf):
@@ -73,6 +132,18 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        # the step the last restore() ACTUALLY returned — differs from the
+        # requested/latest step when integrity fallback walked to an older
+        # one; callers doing step bookkeeping (resume position, rollback
+        # target) must read this, not the step they asked for
+        self.last_restored_step: Optional[int] = None
+
+    def _reg(self):
+        if self._registry is None:
+            from p2p_tpu.obs import get_registry
+
+            self._registry = get_registry()
+        return self._registry
 
     def save(self, step: int, state: TrainState, wait: bool = False) -> None:
         def _save():
@@ -81,6 +152,12 @@ class CheckpointManager:
             if wait:
                 self._mgr.wait_until_finished()
 
+        # A step the manager ALREADY holds is skipped by Orbax (silently
+        # or with a ValueError depending on version): the original bytes
+        # stand, so the original integrity manifest must stand too —
+        # rewriting it with THIS call's (possibly drifted) values would
+        # read as corruption at the next restore.
+        wrote = int(step) not in (self._mgr.all_steps() or [])
         # retry the transient failures (FS blips, injected chaos); a step
         # the manager already holds — e.g. a retry racing an async save
         # that DID land — is success, not an error
@@ -90,30 +167,157 @@ class CheckpointManager:
         except ValueError:
             if step not in (self._mgr.all_steps() or []):
                 raise
+        # per-array save-time checksums — restore() verifies against these
+        # and falls back past a corrupt step (resilience/health.py). The
+        # values fetched here are exactly the arrays handed to Orbax above,
+        # so the manifest names the checkpoint's true content even while
+        # an async save is still flushing. The fetch is deliberately
+        # SYNCHRONOUS: the trainer's next dispatch donates (deletes) these
+        # buffers, so a worker-thread checksum would race use-after-free —
+        # the D2H cost lands once per epoch_save interval, not per step.
+        sums = _leaf_checksums(state) if wrote else None
+        if sums is not None:
+            self._write_aux_json(
+                f"{int(step)}.integrity.json",
+                {"step": int(step), "algo": "crc32", "leaves": sums})
 
-    def restore(self, state_template: TrainState, step: Optional[int] = None):
-        """Restore into the structure/sharding of ``state_template``."""
-        step = self.latest_step() if step is None else step
-        if step is None:
+    def restore(self, state_template: TrainState,
+                step: Optional[int] = None, verify: bool = True,
+                fallback: Optional[bool] = None):
+        """Restore into the structure/sharding of ``state_template``.
+
+        ``step=None`` restores the newest step; the restored leaves are
+        verified against the save-time checksum manifest, and a corrupt
+        (or unreadable) step FALLS BACK to the next older step — a torn
+        final upload costs one checkpoint interval, not the run. An
+        EXPLICITLY named step disables the fallback by default (silently
+        serving different weights than the operator pinned would be worse
+        than failing); the rollback path opts back in with
+        ``fallback=True``. Raises :class:`CheckpointCorrupt`
+        (non-retryable) when nothing intact remains in scope,
+        ``FileNotFoundError`` when the step (or any step) is absent.
+        """
+        if fallback is None:
+            fallback = step is None
+        steps = sorted(int(s) for s in (self._mgr.all_steps() or []))
+        if step is not None:
+            if int(step) not in steps:
+                # an explicitly named step that is ABSENT is a caller
+                # error (wrong --step / wrong directory) — silently
+                # serving an older checkpoint would be worse than failing
+                raise FileNotFoundError(
+                    f"no checkpoint at step {step} (have {steps})")
+            steps = [s for s in steps if s <= int(step)]
+        if not fallback:
+            steps = steps[-1:]
+        if not steps:
             raise FileNotFoundError("no checkpoint found")
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           state_template)
+        tried: List[int] = []
+        last_exc: Optional[BaseException] = None
+        for s in reversed(steps):
+            tried.append(s)
 
-        def _restore():
-            chaos_point("ckpt_restore", step=step)
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract))
+            def _restore(s=s):
+                chaos_point("ckpt_restore", step=s)
+                return self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(abstract))
 
-        return retry_call(_restore, policy=CKPT_POLICY, seam="ckpt_restore",
-                          registry=self._registry)
+            try:
+                restored = retry_call(_restore, policy=CKPT_POLICY,
+                                      seam="ckpt_restore",
+                                      registry=self._registry)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as exc:  # noqa: BLE001 — classified below
+                # transient classes already got their CKPT_POLICY retries;
+                # whatever still raises here marks THIS step unreadable —
+                # fall back rather than die on a torn latest step
+                self._note_corrupt(s, f"restore failed: {exc!r}")
+                last_exc = exc
+                continue
+            if verify:
+                bad = self._verify_integrity(s, restored)
+                if bad:
+                    self._note_corrupt(
+                        s, "checksum mismatch: " + ", ".join(bad[:3])
+                        + ("..." if len(bad) > 3 else ""))
+                    continue
+            self.last_restored_step = s
+            return restored
+        raise CheckpointCorrupt(str(self._mgr.directory), tried,
+                                last_error=last_exc) from last_exc
+
+    def _verify_integrity(self, step: int, restored: Any) -> List[str]:
+        """Leaf paths whose bytes do not match the save-time manifest
+        (empty = intact or unverifiable). Leaves whose dtype/shape differ
+        from the recorded ones are skipped — a cast restore (e.g. an old
+        f32-moment checkpoint into a bf16-moment template) legitimately
+        changes bytes and is not corruption."""
+        manifest = self._read_aux_json(f"{int(step)}.integrity.json")
+        if not manifest or "leaves" not in manifest:
+            return []  # pre-integrity checkpoint: restore unverified
+        try:
+            chaos_point("ckpt_corrupt", step=int(step))
+        except FaultInjected:
+            return ["<chaos:ckpt_corrupt>"]
+        actual = _leaf_checksums(restored)
+        if actual is None:  # multi-process: not checksummable
+            return []
+        bad = []
+        recorded = manifest["leaves"]
+        for path, rec in recorded.items():
+            a = actual.get(path)
+            if (a is None or a["dtype"] != rec["dtype"]
+                    or a["shape"] != rec["shape"]):
+                continue
+            if a["crc32"] != rec["crc32"]:
+                bad.append(path)
+        return bad
+
+    def _note_corrupt(self, step: int, reason: str) -> None:
+        reg = self._reg()
+        reg.counter("ckpt_corrupt_total").inc()
+        reg.record({"kind": "ckpt_corrupt", "step": int(step),
+                    "reason": reason[:500]}, force=True)
+        print(f"WARNING: checkpoint step {step} failed integrity "
+              f"({reason}) — falling back to the previous intact step",
+              flush=True)
+
+    # -- last-good tracking (the recovery ladder's rollback target) -------
+    def mark_good(self, step: int) -> None:
+        """Mark ``step`` eval-validated (the PSNR sweep came back finite):
+        the recovery ladder rolls back to the NEWEST marked step, so a
+        rollback lands on weights that provably evaluated, not merely on
+        whatever checkpoint happens to be latest."""
+        self._write_aux_json(f"{int(step)}.good.json", {"step": int(step)})
+
+    def last_good_step(self) -> Optional[int]:
+        """Newest ``mark_good`` step that still exists on disk, else None."""
+        steps = {int(s) for s in (self._mgr.all_steps() or [])}
+        good = []
+        try:
+            names = os.listdir(self._aux_dir)
+        except OSError:
+            return None
+        for n in names:
+            if n.endswith(".good.json"):
+                try:
+                    s = int(n.split(".", 1)[0])
+                except ValueError:
+                    continue
+                if s in steps:
+                    good.append(s)
+        return max(good) if good else None
 
     # -- iterator-state sidecar (exact-step resume) -----------------------
-    def save_aux(self, step: int, payload: Dict[str, Any]) -> None:
-        """Atomically write the JSON sidecar for ``step`` (tmp + rename —
-        a kill mid-write must never leave a torn sidecar that poisons the
-        next resume)."""
+    def _write_aux_json(self, name: str, payload: Dict[str, Any]) -> None:
+        """Atomically write a JSON sidecar (tmp + rename — a kill
+        mid-write must never leave a torn sidecar that poisons the next
+        resume/verify)."""
         os.makedirs(self._aux_dir, exist_ok=True)
-        path = os.path.join(self._aux_dir, f"{int(step)}.json")
+        path = os.path.join(self._aux_dir, name)
         tmp = path + f".tmp.{os.getpid()}"
 
         def _write():
@@ -124,10 +328,8 @@ class CheckpointManager:
         retry_call(_write, policy=CKPT_POLICY, seam="ckpt_save",
                    registry=self._registry)
 
-    def restore_aux(self, step: int) -> Optional[Dict[str, Any]]:
-        """The sidecar saved with ``step``, or None (pre-resilience
-        checkpoints have none — resume falls back to derived state)."""
-        path = os.path.join(self._aux_dir, f"{int(step)}.json")
+    def _read_aux_json(self, name: str) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self._aux_dir, name)
         if not os.path.exists(path):
             return None
         try:
@@ -135,6 +337,15 @@ class CheckpointManager:
                 return json.load(f)
         except (OSError, json.JSONDecodeError):
             return None
+
+    def save_aux(self, step: int, payload: Dict[str, Any]) -> None:
+        """Atomically write the iterator-state JSON sidecar for ``step``."""
+        self._write_aux_json(f"{int(step)}.json", payload)
+
+    def restore_aux(self, step: int) -> Optional[Dict[str, Any]]:
+        """The sidecar saved with ``step``, or None (pre-resilience
+        checkpoints have none — resume falls back to derived state)."""
+        return self._read_aux_json(f"{int(step)}.json")
 
     def restore_subtree(self, template: Any, step: Optional[int] = None):
         """Restore ONLY the subtree(s) named by ``template`` from a full

@@ -49,7 +49,8 @@ from p2p_tpu.obs import (
     write_manifest,
 )
 from p2p_tpu.resilience import Preempted, PreemptionGuard
-from p2p_tpu.train.checkpoint import CheckpointManager
+from p2p_tpu.resilience.health import DivergenceError
+from p2p_tpu.train.checkpoint import CheckpointCorrupt, CheckpointManager
 from p2p_tpu.train.schedules import PlateauController
 from p2p_tpu.train.state import create_train_state
 from p2p_tpu.train.step import build_eval_step, build_train_step
@@ -93,6 +94,9 @@ def init_trainer_obs(tr) -> None:
 
         tr._sentinel_handler = _handler
         add_sentinel_handler(_handler)
+    # self-healing (resilience/health.py) rides the same wiring point:
+    # both trainers get the sentinel + ladder when cfg.health.enabled
+    init_trainer_health(tr)
 
 
 def close_trainer_obs(tr) -> None:
@@ -122,7 +126,15 @@ def save_trainer_ckpt(tr, wait: bool = False) -> int:
         "epoch": tr.epoch,
         "batches_done": step % tr.steps_per_epoch,
         "steps_per_epoch": tr.steps_per_epoch,
-        "aug_seed": tr.cfg.train.seed + tr.epoch,
+        "aug_seed": tr.cfg.train.seed + tr.epoch
+        + getattr(tr, "_seed_jitter", 0),
+        # health bookkeeping a relaunch must re-derive: the rollback
+        # shuffle perturbation (the resumed epoch must skip against the
+        # PERTURBED permutation) and the BASE lr scale — the device
+        # lr_scale may carry a transient cooldown factor that must not
+        # become permanent across a preempt/resume
+        "seed_jitter": int(getattr(tr, "_seed_jitter", 0)),
+        "lr_base": float(getattr(tr, "_base_lr_scale", 1.0)),
     })
     return step
 
@@ -158,6 +170,11 @@ def derive_resume_position(tr, step: int):
     record for mid-epoch re-entries."""
     done, mid = divmod(int(step), tr.steps_per_epoch)
     aux = tr.ckpt.restore_aux(int(step))
+    if aux is not None and aux.get("seed_jitter") is not None:
+        # a post-rollback run shuffles on a perturbed seed; the relaunch
+        # must re-derive it or the skip below would drop batches of a
+        # DIFFERENT permutation
+        tr._seed_jitter = int(aux["seed_jitter"])
     if aux is not None and aux.get("batches_done") is not None:
         if int(aux.get("steps_per_epoch", tr.steps_per_epoch)) \
                 != tr.steps_per_epoch:
@@ -173,7 +190,8 @@ def derive_resume_position(tr, step: int):
         # a different --seed on the relaunch reshuffles the epoch, so the
         # skip below would drop batches of a DIFFERENT permutation —
         # replayed/skipped samples the step counter cannot see
-        want_aug = tr.cfg.train.seed + done + 1
+        want_aug = tr.cfg.train.seed + done + 1 \
+            + getattr(tr, "_seed_jitter", 0)
         if mid and int(aux.get("aug_seed", want_aug)) != want_aug:
             print(
                 f"WARNING: mid-epoch resume with a different --seed "
@@ -216,6 +234,187 @@ def release_preempt_guard(tr, owned_guard) -> None:
     if owned_guard is not None:
         owned_guard.uninstall()
         tr.preempt = None
+
+
+# --------------------------------------------------------------------------
+# Self-healing (resilience/health.py): shared by Trainer and VideoTrainer.
+# The sentinel reads each dispatch's metrics ONE DISPATCH LATE — by the
+# time the host fetches them the producing computation has retired while
+# the next dispatch runs, so the happy path never fences the device.
+# --------------------------------------------------------------------------
+
+
+def init_trainer_health(tr) -> None:
+    """Sentinel + ladder wiring (both trainers call this after their obs
+    init). ``tr._host_step`` mirrors the device step counter so the
+    health path never fetches ``state.step``."""
+    tr.health = None
+    tr._pending_health = None
+    tr._seed_jitter = 0
+    tr._base_lr_scale = 1.0
+    tr._applied_lr_scale = 1.0
+    tr._host_step = 0
+    if tr.cfg.health.enabled:
+        from p2p_tpu.resilience.health import TrainingHealth
+
+        tr.health = TrainingHealth(tr.cfg.health, registry=tr.obs,
+                                   logger=tr.logger)
+
+
+def apply_health_lr(tr) -> None:
+    """Fold (plateau scale × cooldown multiplier) into the device
+    ``lr_scale`` — only touching the state when the product changed, so
+    the steady state costs one float compare."""
+    mult = tr.health.lr_multiplier if tr.health is not None else 1.0
+    want = float(tr._base_lr_scale) * float(mult)
+    if want != tr._applied_lr_scale:
+        import jax.numpy as jnp
+
+        tr.state = tr.state.replace(
+            lr_scale=jnp.asarray(want, jnp.float32))
+        tr._applied_lr_scale = want
+
+
+def queue_health_observation(tr, metrics_dev, k: int) -> None:
+    """Queue this dispatch's (device) metrics for the delayed read and
+    consume the PREVIOUS dispatch's. ``metrics_dev`` is the per-step
+    stacked tree for a scanned dispatch (k > 1) or the single step's
+    metrics (k == 1)."""
+    if tr.health is None:
+        tr._host_step += k
+        return
+    prev, tr._pending_health = (
+        tr._pending_health, (tr._host_step + 1, metrics_dev, k))
+    tr._host_step += k
+    if prev is not None:
+        consume_health_observation(tr, prev)
+
+
+def flush_health_observations(tr) -> None:
+    """Drain the delayed slot (end of epoch / before eval or checkpoint:
+    the last dispatch must not escape the sentinel)."""
+    if tr.health is None:
+        return
+    pend, tr._pending_health = tr._pending_health, None
+    if pend is not None:
+        consume_health_observation(tr, pend)
+
+
+def consume_health_observation(tr, pend) -> None:
+    """Fetch one queued dispatch's metrics and walk them through the
+    sentinel + ladder, one step at a time. The ``nan`` chaos seam poisons
+    the OBSERVED losses here — the ladder rehearsal hook
+    (``P2P_CHAOS=nan@50x3`` fails steps 50..52)."""
+    from p2p_tpu.resilience.health import poison_nan_observation
+
+    first_step, dev, k = pend
+    host = jax.device_get(dev)
+    for i in range(k):
+        step = first_step + i
+        m = {key: float(v[i]) if k > 1 else float(v)
+             for key, v in host.items()}
+        action = tr.health.observe(step, poison_nan_observation(step, m))
+        if action == "rollback":
+            break
+    apply_health_lr(tr)
+
+
+def perform_rollback(tr) -> None:
+    """Recovery-ladder rung 3: restore the last eval-validated
+    (``mark_good``) checkpoint — falling back to the newest intact step
+    when nothing is marked yet — re-enter its epoch with a PERTURBED
+    data-shuffle seed (the diverging batch order must not replay
+    verbatim), and re-arm the post-rollback LR cooldown."""
+    cur_step = tr._host_step
+    target = tr.ckpt.last_good_step()
+    if target is None:
+        target = tr.ckpt.latest_step()
+    if target is None:
+        raise DivergenceError(cur_step, tr.health.ladder.rollbacks,
+                              "no checkpoint to roll back to")
+    tr.ckpt.wait()  # an async save mid-flight must finish before restore
+    # fallback=True: a corrupt rollback target must walk to an older
+    # intact step rather than kill the self-healing path itself
+    tr.state = tr.ckpt.restore(tr.state, step=int(target), fallback=True)
+    # integrity fallback may have landed on an older intact step — the
+    # position/step bookkeeping must follow the weights actually restored
+    if tr.ckpt.last_restored_step is not None:
+        target = tr.ckpt.last_restored_step
+    done, mid = divmod(int(target), tr.steps_per_epoch)
+    aux = tr.ckpt.restore_aux(int(target))
+    if aux is not None and aux.get("batches_done") is not None:
+        mid = int(aux["batches_done"])
+        done = (int(target) - mid) // tr.steps_per_epoch
+    tr.epoch = done + 1
+    tr._resume_skip = mid
+    tr._seed_jitter += 1000003  # new shuffle permutation from here on
+    tr._pending_health = None
+    tr._host_step = int(target)
+    tr.health.after_rollback(cur_step, int(target))
+    # the restore overwrote lr_scale with the checkpoint's value — resync
+    # the host cache so apply_health_lr compares against reality
+    tr._applied_lr_scale = float(np.asarray(jax.device_get(
+        tr.state.lr_scale)))
+    apply_health_lr(tr)  # post-rollback cooldown engages immediately
+    tr.logger.log(
+        {"kind": "rollback", "step": int(cur_step),
+         "target_step": int(target), "epoch": tr.epoch,
+         "skip_batches": mid, "rollbacks": tr.health.ladder.rollbacks},
+        force=True,
+    )
+
+
+def log_health_summary(tr) -> None:
+    if tr.health is not None:
+        tr.logger.log({"kind": "health_summary", **tr.health.summary()},
+                      force=True)
+
+
+def mask_skipped_metrics(metrics, k: int):
+    """The epoch accumulator's view of one dispatch: every metric of a
+    SKIPPED step (``health_ok == 0`` — the in-jit guard dropped its
+    update) zeroed, then summed over the scan axis. A single NaN step
+    would otherwise poison the whole epoch's averages and feed NaN to the
+    plateau controller. Without ``health_ok`` (guard off) this is the
+    plain scan-axis sum the loop always used."""
+    import jax.numpy as jnp
+
+    ok = metrics.get("health_ok")
+    if ok is not None:
+        okb = ok >= 0.5
+        # where, not multiply: NaN · 0 = NaN
+        metrics = {
+            key: (v if key == "health_ok"
+                  else jnp.where(okb, v, jnp.zeros_like(v)))
+            for key, v in metrics.items()
+        }
+    if k > 1:
+        metrics = jax.tree_util.tree_map(
+            lambda v: jnp.sum(v, axis=0), metrics)
+    return metrics
+
+
+def epoch_metric_means(host_sums, count: int):
+    """Per-step means from the (masked) epoch sums: loss metrics average
+    over the APPLIED steps (``health_ok`` sum), while ``health_ok``
+    itself averages over ALL steps — the applied fraction."""
+    n_ok = host_sums.get("health_ok")
+    denom = max(float(n_ok) if n_ok is not None else count, 1.0)
+    return {
+        key: float(v) / (count if key == "health_ok" else denom)
+        for key, v in host_sums.items()
+    }
+
+
+def eval_state_of(tr):
+    """The state eval should score: EMA generator weights when carried
+    (HealthConfig.ema_decay), raw weights otherwise. At ema_decay=0 the
+    EMA tracks params exactly, so the two are pinned bitwise-equal."""
+    st = tr.state
+    ema = getattr(st, "ema_g", None)
+    if ema is not None:
+        st = st.replace(params_g=ema)
+    return st
 
 
 def local_metric_rows(vec) -> np.ndarray:
@@ -534,7 +733,24 @@ class Trainer:
         step = self.ckpt.latest_step()
         if step is None:
             return False
-        self.state = self.ckpt.restore(self.state)
+        try:
+            self.state = self.ckpt.restore(self.state)
+        except CheckpointCorrupt as e:
+            if self.cfg.health.ema_decay is not None:
+                # the likeliest cause: --ema_decay was ADDED over a
+                # checkpoint saved without the EMA tree — every step then
+                # fails the template restore identically, which must not
+                # read as disk corruption
+                raise RuntimeError(
+                    "restore failed with --ema_decay set: if these "
+                    "checkpoints were saved WITHOUT the EMA generator, "
+                    "resume without --ema_decay (EMA can only start on a "
+                    f"fresh run); underlying: {e}") from e
+            raise
+        # integrity fallback may have restored an OLDER intact step than
+        # latest — position bookkeeping must follow the ACTUAL weights
+        if self.ckpt.last_restored_step is not None:
+            step = self.ckpt.last_restored_step
         # Exact-step resume: a mid-epoch (preemption) checkpoint re-enters
         # its epoch at batch `mid` — the loader skips exactly the batches
         # the killed run consumed (same shuffle: the epoch seed is a pure
@@ -566,10 +782,25 @@ class Trainer:
                 train=dataclasses.replace(self.cfg.train, epoch_count=eff),
             )
             self._build_step_fns()
+        # the restored lr_scale may carry a transient cooldown factor
+        # (preempted mid-cooldown); the sidecar's lr_base names the real
+        # plateau scale — reset to it so the 10x reduction isn't permanent
+        aux = self.ckpt.restore_aux(int(step))
+        base = (aux or {}).get("lr_base")
+        if base is not None \
+                and float(np.asarray(self.state.lr_scale)) != float(base):
+            import jax.numpy as jnp
+
+            self.state = self.state.replace(
+                lr_scale=jnp.asarray(float(base), jnp.float32))
         if self.plateau is not None:
             # lr_scale only ever decreases; seed the fresh controller from
             # the restored state so resume doesn't undo prior reductions.
             self.plateau.scale = float(np.asarray(self.state.lr_scale))
+        # the health LR bookkeeping must agree with the restored scale
+        self._base_lr_scale = float(np.asarray(self.state.lr_scale))
+        self._applied_lr_scale = self._base_lr_scale
+        self._host_step = int(step)
         return True
 
     def train_epoch(self, seed: Optional[int] = None,
@@ -578,8 +809,11 @@ class Trainer:
         # Per-epoch entropy (shuffle order + augmentation crops),
         # reproducible across same-seed runs. Defaults to the current
         # epoch so bare train_epoch() loops still see fresh crops each
-        # epoch rather than a frozen augmented stream.
+        # epoch rather than a frozen augmented stream. A rollback
+        # (perform_rollback) perturbs the jitter so the diverging batch
+        # order is not replayed verbatim.
         seed = self.epoch if seed is None else seed
+        seed = seed + getattr(self, "_seed_jitter", 0)
         self.train_ds.aug_seed = cfg.train.seed + seed
         # Worker processes are pickled a FRESH copy of the dataset each
         # epoch, which would empty the decode memo and re-decode every
@@ -637,6 +871,10 @@ class Trainer:
                         self.state, batch_or_stack)
                     step_metrics = last
             self._img_rate.mark(k * cfg.data.batch_size)
+            # divergence sentinel: queue THIS dispatch, read the previous
+            # one (already retired — no fence); scanned dispatches feed
+            # their per-step stacked metrics so no step escapes
+            queue_health_observation(self, metrics if k > 1 else last, k)
             if cfg.debug.check_finite:
                 # host-side guard (fences this dispatch): the nonfinite
                 # record lands in the metrics stream BEFORE the raise.
@@ -646,6 +884,12 @@ class Trainer:
                 from p2p_tpu.core.debug import check_finite
 
                 check_finite(step_metrics, "step_metrics", registry=self.obs)
+            # a skipped step's NaN losses must not poison the epoch-sum
+            # averages (or the plateau controller fed from them): mask
+            # skipped steps out of the ACCUMULATOR only — the raw values
+            # still reach the sentinel/check_finite/log paths above
+            step_metrics = mask_skipped_metrics(
+                metrics if k > 1 else last, k)
             if count > 0 and k not in seen_kinds:
                 # first use of this dispatch shape mid-epoch (e.g. the
                 # single-step remainder after scanned dispatches): the call
@@ -713,6 +957,10 @@ class Trainer:
 
         for batch, k in dispatch_batches():
             run(batch, k)
+            # recovery ladder rung 3: stop feeding batches — fit() owns
+            # the restore-and-reenter policy (perform_rollback)
+            if self.health is not None and self.health.rollback_pending:
+                break
             # Preemption poll at the step boundary (cross-host agreed —
             # every process runs the same dispatch count, so the agreement
             # collective stays aligned). The flag is only SET here; fit()
@@ -720,11 +968,14 @@ class Trainer:
             if self.preempt is not None and self.preempt.should_stop():
                 self._preempted = True
                 break
+        # drain the delayed sentinel slot: the epoch's last dispatch must
+        # not escape classification (it may be the diverging one)
+        flush_health_observations(self)
         if sums is None:
             return {}
         host_sums = jax.device_get(sums)  # fences the epoch's last step
         elapsed = time.perf_counter() - t0 - compile_skew
-        out = {k: float(v) / count for k, v in host_sums.items()}
+        out = epoch_metric_means(host_sums, count)
         if count > first_k:
             out["img_per_sec"] = (
                 (count - first_k) * cfg.data.batch_size / max(elapsed, 1e-9)
@@ -772,11 +1023,14 @@ class Trainer:
                     }
                 yield b, n
 
+        # EMA generator weights when carried (HealthConfig.ema_decay) —
+        # eval scores the smoothed G, bitwise == raw at ema_decay=0
+        est = eval_state_of(self)
         sample_saved = False
         for batch, n_real in device_prefetch(
             padded(loader), self.batch_sharding, with_aux=True
         ):
-            pred, metrics = self.eval_step(self.state, batch)
+            pred, metrics = self.eval_step(est, batch)
             if fid_eval is not None:
                 # ingest: uint8-pipeline targets normalize to [-1,1] first
                 fid_eval.update(ingest(batch["target"][:n_real]),
@@ -789,7 +1043,7 @@ class Trainer:
                 # comp is an SPMD computation over a (possibly) global
                 # array: EVERY process must execute it — only the file
                 # writes below are process-0-only.
-                comp = (self.comp_fn(self.state, batch["target"])
+                comp = (self.comp_fn(est, batch["target"])
                         if self.comp_fn is not None else None)
 
                 def first_img(arr):
@@ -866,8 +1120,11 @@ class Trainer:
         cfg = self.cfg
         nepoch = nepoch or cfg.train.nepoch
         history = []
-        first_epoch = self.epoch
+        armed_retrace = False  # armed after the first COMPLETED epoch
         self._preempted = False
+        # host mirror of the device step counter (the health path must
+        # never fetch state.step mid-epoch) — one scalar fetch per fit()
+        self._host_step = int(np.asarray(jax.device_get(self.state.step)))
         owned_guard = acquire_preempt_guard(self)
         try:
             while self.epoch <= nepoch:
@@ -876,6 +1133,7 @@ class Trainer:
                 # restore skips exactly the batches the killed run consumed
                 skip = self._resume_skip
                 self._resume_skip = 0
+                rollback = False
                 with self.spans.span("epoch", epoch=self.epoch):
                     train_metrics = self.train_epoch(seed=self.epoch,
                                                      skip_batches=skip)
@@ -884,13 +1142,22 @@ class Trainer:
                     lr = self.current_lr()
                     if lr is not None:  # reference prints LR per epoch (networks.py:125)
                         record["lr"] = lr
-                    if cfg.train.eval_every_epoch and not self._preempted:
+                    rollback = (self.health is not None
+                                and self.health.rollback_pending)
+                    if cfg.train.eval_every_epoch and not self._preempted \
+                            and not rollback:
                         record.update(self.evaluate(save_samples=True))
                 if self._preempted:
                     # partial epoch: no epoch record (downstream tooling
                     # reads those as COMPLETED epochs) — save the exact
                     # step + iterator sidecar and exit as "resume me"
                     finish_preempted(self)  # raises Preempted
+                if rollback:
+                    # recovery ladder rung 3: restore the last-good step,
+                    # re-enter its epoch on a perturbed shuffle — no epoch
+                    # record (the diverged partial epoch didn't complete)
+                    perform_rollback(self)
+                    continue
                 history.append(record)
                 # epoch summary (incl. lr) into the metrics stream — the
                 # jsonl otherwise only carries per-step and eval records, so
@@ -900,30 +1167,44 @@ class Trainer:
                 if self.plateau is not None and "loss_g" in record:
                     # feed the generator loss, mode='min' (reference plateau);
                     # the returned scale multiplies every optimizer update
-                    # inside the jitted step via TrainState.lr_scale.
-                    scale = self.plateau.update(record["loss_g"])
-                    import jax.numpy as jnp
-
-                    self.state = self.state.replace(
-                        lr_scale=jnp.asarray(scale, jnp.float32)
-                    )
+                    # inside the jitted step via TrainState.lr_scale
+                    # (composed with the health ladder's cooldown factor).
+                    self._base_lr_scale = self.plateau.update(
+                        record["loss_g"])
+                    apply_health_lr(self)
                 if self.epoch % cfg.train.epoch_save == 0 \
                         or self.epoch == nepoch:
                     with self.spans.span("checkpoint_save", epoch=self.epoch):
-                        save_trainer_ckpt(self)
-                if self.epoch == first_epoch:
-                    # warmup epoch compiled every dispatch shape (scan body,
-                    # remainder, eval, comp_fn) — compiles from here on are
-                    # suspect. The first async checkpoint save may still warn
-                    # once; the watchdog only reports, never raises.
+                        saved_step = save_trainer_ckpt(self)
+                    # last-good tracking: the eval PSNR sweep validates the
+                    # step — rollback targets the newest MARKED step
+                    psnr = record.get("psnr_mean")
+                    if psnr is not None and np.isfinite(psnr):
+                        self.ckpt.mark_good(saved_step)
+                if not armed_retrace:
+                    # the first COMPLETED epoch compiled every dispatch
+                    # shape (scan body, remainder, eval, comp_fn) —
+                    # compiles from here on are suspect. Flag-based, not
+                    # epoch-label-based: a rollback rewrites self.epoch
+                    # and must not leave the watchdog unarmed forever.
+                    # The first async checkpoint save may still warn once;
+                    # the watchdog only reports, never raises.
                     self.retrace.arm()
+                    armed_retrace = True
                 self.epoch += 1
         finally:
+            # the epilogue runs on EVERY exit — completed, Preempted, or
+            # DivergenceError (exit 76): an in-flight async save must be
+            # awaited and the health summary is most valuable exactly on
+            # the runs that die (the audit trail of how/why the ladder
+            # fired).
             release_preempt_guard(self, owned_guard)
-        self.ckpt.wait()
-        # Perfetto-loadable host-span trace next to the metrics stream
-        # (each fit() call rewrites it with the accumulated spans).
-        if jax.process_index() == 0:
-            self.spans.export_perfetto(self._trace_path)
-        self.logger.registry.flush()
+            self.ckpt.wait()
+            # Perfetto-loadable host-span trace next to the metrics stream
+            # (each fit() call rewrites it with the accumulated spans).
+            if jax.process_index() == 0:
+                self.spans.export_perfetto(self._trace_path)
+            # one auditable line per run: how often the ladder fired
+            log_health_summary(self)
+            self.logger.registry.flush()
         return history

@@ -54,6 +54,11 @@ class TrainState(struct.PyTreeNode):
     # keep restoring bit-for-bit.
     pp_stages: Any = None
     opt_s: Optional[optax.OptState] = None
+    # EMA generator params (HealthConfig.ema_decay — the ProGAN-lineage
+    # stabilization lever): updated in-step, used by eval/serve when
+    # present. None when EMA is off — None flattens to an empty subtree,
+    # so pre-round-6 checkpoints keep restoring bit-for-bit.
+    ema_g: Any = None
 
 
 class InferState(struct.PyTreeNode):
@@ -77,6 +82,10 @@ class InferState(struct.PyTreeNode):
     # delayed-int8 stored activation scales; in eval mode the 'quant'
     # collection is read-only, so these act as FROZEN inference scales
     quant_g: Any = None
+    # EMA generator params, restored when the checkpoint carries them
+    # (HealthConfig.ema_decay) — the serving engine swaps them in for
+    # params_g (ProGAN-lineage: serve the smoothed generator)
+    ema_g: Any = None
 
 
 def create_infer_state(
@@ -113,6 +122,10 @@ def create_infer_state(
         params_c=params_c,
         batch_stats_c=batch_stats_c,
         quant_g=vg.get("quant", {}) if delayed else None,
+        # with EMA on, the template names ema_g so restore_subtree reads
+        # the smoothed weights from disk too (same tree as params_g)
+        ema_g=(jax.tree_util.tree_map(jnp.copy, vg["params"])
+               if cfg.health.ema_decay is not None else None),
     )
 
 
@@ -126,6 +139,7 @@ def infer_state_from_train(state: "TrainState") -> InferState:
         params_c=state.params_c,
         batch_stats_c=state.batch_stats_c,
         quant_g=state.quant_g,
+        ema_g=state.ema_g,
     )
 
 
@@ -171,6 +185,46 @@ def count_nonfinite(tree: Any) -> jax.Array:
     return sum(
         jnp.sum(~jnp.isfinite(g)).astype(jnp.int32) for g in leaves
     )
+
+
+def losses_finite(*losses) -> jax.Array:
+    """Scalar bool: every loss is finite — the in-jit skip guard's verdict
+    (recovery-ladder rung 1, resilience/health.py). Checked on the LOSS
+    scalars, not the gradient trees: the losses already reduce every
+    forward activation, so a blown-up batch surfaces here without paying
+    a separate full-gradient reduction pass on the healthy path."""
+    ok = jnp.isfinite(losses[0])
+    for l in losses[1:]:
+        ok = ok & jnp.isfinite(l)
+    return ok
+
+
+def health_select(ok: jax.Array, new_tree: Any, old_tree: Any) -> Any:
+    """Per-leaf ``where(ok, new, old)`` over matching pytrees — the skip
+    guard's state gate. Each select fuses into the kernel that produced
+    the ``new`` leaf (the old leaf was already read to compute it), so
+    the guard adds no extra HBM pass on the healthy path."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree)
+
+
+def zero_if_unhealthy(ok: jax.Array, grads: Any) -> Any:
+    """``where(ok, g, 0)`` per gradient leaf. Uses where, NOT ``g * ok``:
+    with non-finite gradients NaN·0 = NaN and the poison would reach the
+    optimizer moments anyway."""
+    return jax.tree_util.tree_map(
+        lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads)
+
+
+def ema_update(ema: Any, params: Any, decay: float) -> Any:
+    """``ema·d + params·(1−d)`` per leaf in the EMA's own dtype. d=0 makes
+    the EMA track params EXACTLY (0·e + 1·p = p bitwise — the parity-pin
+    mode); d→1 is the ProGAN-lineage smoothing."""
+    d = float(decay)
+    return jax.tree_util.tree_map(
+        lambda e, p: (e * jnp.asarray(d, e.dtype)
+                      + p.astype(e.dtype) * jnp.asarray(1.0 - d, e.dtype)),
+        ema, params)
 
 
 def scale_by_adam_lp(b1: float, b2: float, eps: float,
@@ -318,6 +372,11 @@ def create_train_state(
         pool_n = jnp.zeros((), jnp.int32)
 
     delayed = cfg.model.int8_delayed
+    # EMA generator (HealthConfig.ema_decay): seeded with the init params
+    # so step 1's blend is well-defined; decay=0 keeps ema == params
+    # bitwise (the parity-pin mode), decay->1 smooths
+    ema_g = (jax.tree_util.tree_map(jnp.copy, vg["params"])
+             if cfg.health.ema_decay is not None else None)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         lr_scale=jnp.ones((), jnp.float32),
@@ -335,4 +394,5 @@ def create_train_state(
         quant_g=vg.get("quant", {}) if delayed else None,
         quant_d=vd.get("quant", {}) if delayed else None,
         quant_c=None,
+        ema_g=ema_g,
     )

@@ -245,6 +245,14 @@ def build_train_step(
     use_quant = cfg.model.int8_delayed
     d_colls = ("spectral", "quant") if use_quant else ("spectral",)
     g_loss_fn = make_g_loss_fn(cfg, vgg_params, steps_per_epoch)
+    # Self-healing (resilience/health.py, rung 1 of the recovery ladder):
+    # a non-finite step SKIPS — gradients are zeroed before they can
+    # poison the Adam moments, the update scale folds to 0 (params
+    # bitwise unchanged: p + 0·u = p), and every threaded collection
+    # selects its old value. The selects fuse into the kernels that
+    # produce the new values, so the healthy path pays ~nothing.
+    health_guard = cfg.health.enabled
+    ema_decay = cfg.health.ema_decay
 
     def g_fwd(params, bstats, quant, x, rng=None):
         rngs = {"dropout": rng} if (use_dropout and rng is not None) else None
@@ -419,10 +427,26 @@ def build_train_step(
         spectral2 = dvars2["spectral"]
         quant_d1 = dvars2.get("quant") if use_quant else None
 
+        # ---- skip guard (health ladder rung 1) --------------------------
+        ok = None
+        if health_guard:
+            from p2p_tpu.train.state import (
+                health_select,
+                losses_finite,
+                zero_if_unhealthy,
+            )
+
+            ok = losses_finite(loss_g, loss_d)
+            grads_g = zero_if_unhealthy(ok, grads_g)
+            grads_d = zero_if_unhealthy(ok, grads_d)
+
         # ---- 4. apply G then D updates (reference order) ----------------
         # lr_scale: Adam updates are linear in lr, so the host-driven
         # plateau multiplier is applied to the update trees directly.
         scale = state.lr_scale.astype(jnp.float32)
+        if ok is not None:
+            # skipped step: updates scale to 0 — params unchanged bitwise
+            scale = scale * ok.astype(jnp.float32)
         scale_tree = lambda ups: jax.tree_util.tree_map(  # noqa: E731
             lambda u: u * scale.astype(u.dtype), ups
         )
@@ -430,6 +454,30 @@ def build_train_step(
         params_g1 = optax.apply_updates(state.params_g, scale_tree(up_g))
         up_d, opt_d1 = opt_d.update(grads_d, state.opt_d, state.params_d)
         params_d1 = optax.apply_updates(state.params_d, scale_tree(up_d))
+        if ok is not None:
+            # a skipped step must not advance the optimizer moments/count
+            # (zeroed grads still decay them) or absorb the step's NaN-
+            # tainted collection updates
+            opt_g1 = health_select(ok, opt_g1, state.opt_g)
+            opt_d1 = health_select(ok, opt_d1, state.opt_d)
+            spectral2 = health_select(ok, spectral2, state.spectral_d)
+            if use_quant:
+                quant_g1 = health_select(ok, quant_g1, state.quant_g)
+                quant_d1 = health_select(ok, quant_d1, state.quant_d)
+            if use_pool:
+                pool1 = health_select(ok, pool1, state.pool)
+                pool_n1 = health_select(ok, pool_n1, state.pool_n)
+
+        # ---- EMA generator (HealthConfig.ema_decay) ---------------------
+        ema_g1 = state.ema_g
+        if ema_decay is not None and state.ema_g is not None:
+            from p2p_tpu.train.state import ema_update
+
+            ema_g1 = ema_update(state.ema_g, params_g1, ema_decay)
+            if ok is not None:
+                from p2p_tpu.train.state import health_select
+
+                ema_g1 = health_select(ok, ema_g1, state.ema_g)
 
         # ---- 5. compression branch vs the UPDATED generator -------------
         loss_c = jnp.zeros((), jnp.float32)
@@ -456,6 +504,19 @@ def build_train_step(
                 up_c, opt_c1 = opt_c.update(grads_c, state.opt_c, state.params_c)
                 params_c1 = optax.apply_updates(state.params_c, scale_tree(up_c))
 
+        ok_all = ok
+        if ok is not None:
+            # the C branch runs after the G/D gate and can blow up on its
+            # own; the BN stats (G advanced twice, C once) absorb NaN
+            # activations even when the loss scalars read finite late —
+            # gate them all on the combined verdict
+            if use_c:
+                ok_all = ok & jnp.isfinite(loss_c)
+                params_c1 = health_select(ok_all, params_c1, state.params_c)
+                opt_c1 = health_select(ok_all, opt_c1, state.opt_c)
+            bs_g2 = health_select(ok_all, bs_g2, state.batch_stats_g)
+            bs_c1 = health_select(ok_all, bs_c1, state.batch_stats_c)
+
         new_state = state.replace(
             step=state.step + 1,
             params_g=params_g1,
@@ -471,6 +532,7 @@ def build_train_step(
             pool_n=pool_n1,
             quant_g=quant_g1,
             quant_d=quant_d1,
+            ema_g=ema_g1,
         )
         metrics = {
             "loss_d": loss_d.astype(jnp.float32),
@@ -478,6 +540,10 @@ def build_train_step(
             "loss_c": loss_c,
             **{k: v.astype(jnp.float32) for k, v in g_parts.items()},
         }
+        if ok_all is not None:
+            # 1.0 = updates applied, 0.0 = the skip guard dropped this
+            # step; the host sentinel counts the skips off this flag
+            metrics["health_ok"] = ok_all.astype(jnp.float32)
         if cfg.debug.grad_norms:
             # in-graph global norms; they ride the metrics fetch the loop
             # already pays for — no extra sync
@@ -551,6 +617,14 @@ def build_pp_train_step(
         trunk_prefix,
     )
 
+    if cfg.health.ema_decay is not None:
+        # the EMA blend needs the FUSED generator params; the PP state
+        # splits the trunk into the stage stack — decline loudly rather
+        # than silently track only the encoder/decoder
+        raise ValueError(
+            "health.ema_decay is not supported on the pipelined step "
+            "(v1 bound: the trunk lives in pp_stages); run EMA configs "
+            "unpipelined")
     trunk_prefix(cfg.model)  # fail early on non-trunk generator families
     if cfg.train.pool_size > 0:
         raise ValueError(
@@ -570,6 +644,7 @@ def build_pp_train_step(
     use_quant_d = cfg.model.int8_delayed
     d_colls = ("spectral", "quant") if use_quant_d else ("spectral",)
     g_loss_fn = make_g_loss_fn(cfg, vgg_params, steps_per_epoch)
+    health_guard = cfg.health.enabled
 
     def d_fwd(params, dvars, x):
         out, mut = d.apply(
@@ -667,8 +742,26 @@ def build_pp_train_step(
             pull(ct_pred)[1] if split else pull(ct_pred)[..., in_c:])
         grads_g, grads_s = g_vjp(grad_fake)
 
+        # skip guard (health ladder rung 1) — same contract as the
+        # unpipelined step: a non-finite step applies NO update anywhere,
+        # stage stack included
+        ok = None
+        if health_guard:
+            from p2p_tpu.train.state import (
+                health_select,
+                losses_finite,
+                zero_if_unhealthy,
+            )
+
+            ok = losses_finite(loss_g, loss_d)
+            grads_g = zero_if_unhealthy(ok, grads_g)
+            grads_s = zero_if_unhealthy(ok, grads_s)
+            grads_d = zero_if_unhealthy(ok, grads_d)
+
         # ---- 4. apply G (enc/dec + pipe-sharded stages) then D ---------
         scale = state.lr_scale.astype(jnp.float32)
+        if ok is not None:
+            scale = scale * ok.astype(jnp.float32)
         scale_tree = lambda ups: jax.tree_util.tree_map(  # noqa: E731
             lambda u: u * scale.astype(u.dtype), ups
         )
@@ -680,6 +773,20 @@ def build_pp_train_step(
             state.pp_stages["params"], scale_tree(up_s))
         up_d, opt_d1 = opt_d.update(grads_d, state.opt_d, state.params_d)
         params_d1 = optax.apply_updates(state.params_d, scale_tree(up_d))
+        dvars2_spectral = dvars2["spectral"]
+        quant_s_out = quant_s1
+        quant_d_out = dvars2.get("quant") if use_quant_d else None
+        if ok is not None:
+            opt_g1 = health_select(ok, opt_g1, state.opt_g)
+            opt_s1 = health_select(ok, opt_s1, state.opt_s)
+            opt_d1 = health_select(ok, opt_d1, state.opt_d)
+            dvars2_spectral = health_select(ok, dvars2_spectral,
+                                            state.spectral_d)
+            if has_q:
+                quant_s_out = health_select(ok, quant_s1,
+                                            stages_aux.get("quant"))
+            if use_quant_d:
+                quant_d_out = health_select(ok, quant_d_out, state.quant_d)
 
         # ---- 5. compression branch vs the UPDATED pipelined generator --
         loss_c = jnp.zeros((), jnp.float32)
@@ -705,9 +812,17 @@ def build_pp_train_step(
                 params_c1 = optax.apply_updates(
                     state.params_c, scale_tree(up_c))
 
+        ok_all = ok
+        if ok is not None and use_c:
+            ok_all = ok & jnp.isfinite(loss_c)
+            params_c1 = health_select(ok_all, params_c1, state.params_c)
+            opt_c1 = health_select(ok_all, opt_c1, state.opt_c)
+        if ok is not None:
+            bs_c1 = health_select(ok_all, bs_c1, state.batch_stats_c)
+
         pp_stages1 = {"params": stages_p1, **stages_aux}
         if has_q:
-            pp_stages1["quant"] = quant_s1
+            pp_stages1["quant"] = quant_s_out
         new_state = state.replace(
             step=state.step + 1,
             params_g=params_g1,
@@ -715,12 +830,12 @@ def build_pp_train_step(
             pp_stages=pp_stages1,
             opt_s=opt_s1,
             params_d=params_d1,
-            spectral_d=dvars2["spectral"],
+            spectral_d=dvars2_spectral,
             opt_d=opt_d1,
             params_c=params_c1,
             batch_stats_c=bs_c1,
             opt_c=opt_c1,
-            quant_d=dvars2.get("quant") if use_quant_d else None,
+            quant_d=quant_d_out,
         )
         metrics = {
             "loss_d": loss_d.astype(jnp.float32),
@@ -728,6 +843,8 @@ def build_pp_train_step(
             "loss_c": loss_c,
             **{k: v.astype(jnp.float32) for k, v in g_parts.items()},
         }
+        if ok_all is not None:
+            metrics["health_ok"] = ok_all.astype(jnp.float32)
         # same debug surface as build_train_step — the obs flags must not
         # silently no-op just because the generator is pipelined
         if cfg.debug.grad_norms:

@@ -28,10 +28,17 @@ from p2p_tpu.resilience import PreemptionGuard
 from p2p_tpu.train.checkpoint import CheckpointManager
 from p2p_tpu.train.loop import (
     acquire_preempt_guard,
+    apply_health_lr,
     close_trainer_obs,
     derive_resume_position,
+    epoch_metric_means,
     finish_preempted,
+    flush_health_observations,
     init_trainer_obs,
+    log_health_summary,
+    mask_skipped_metrics,
+    perform_rollback,
+    queue_health_observation,
     release_preempt_guard,
     save_trainer_ckpt,
 )
@@ -175,6 +182,9 @@ class VideoTrainer:
         if step is None:
             return False
         self.state = self.ckpt.restore(self.state)
+        # integrity fallback may have restored an OLDER intact step
+        if self.ckpt.last_restored_step is not None:
+            step = self.ckpt.last_restored_step
         # exact-step resume (shared with Trainer.maybe_resume): a
         # mid-epoch (preemption) checkpoint re-enters its epoch at
         # clip-batch `mid`
@@ -192,13 +202,25 @@ class VideoTrainer:
                 train=dataclasses.replace(self.cfg.train, epoch_count=eff),
             )
             self._build_step_fns()
+        # drop a preempt-frozen transient cooldown factor (cf. Trainer)
+        aux = self.ckpt.restore_aux(int(step))
+        base = (aux or {}).get("lr_base")
+        if base is not None \
+                and float(np.asarray(self.state.lr_scale)) != float(base):
+            self.state = self.state.replace(
+                lr_scale=jnp.asarray(float(base), jnp.float32))
         if self.plateau is not None:
             self.plateau.scale = float(np.asarray(self.state.lr_scale))
+        self._base_lr_scale = float(np.asarray(self.state.lr_scale))
+        self._applied_lr_scale = self._base_lr_scale
+        self._host_step = int(step)
         return True
 
     def train_epoch(self, seed: int = 0,
                     skip_batches: int = 0) -> Dict[str, float]:
         cfg = self.cfg
+        # rollback perturbation (perform_rollback) — cf. Trainer.train_epoch
+        seed = seed + getattr(self, "_seed_jitter", 0)
         loader = make_loader(
             self.train_ds, self.local_bs, shuffle=True,
             seed=cfg.train.seed + seed,
@@ -236,12 +258,18 @@ class VideoTrainer:
                     self.state, last = self.train_step(self.state, batch)
                     step_metrics = last
             self._img_rate.mark(k * cfg.data.batch_size * cfg.data.n_frames)
+            # divergence sentinel: delayed read, per-step rows on the
+            # scan path (cf. Trainer.train_epoch)
+            queue_health_observation(self, metrics if k > 1 else last, k)
             if cfg.debug.check_finite:
                 # scan-axis sum: catches an intermediate scanned step's
                 # NaN/Inf, not just the last slice (cf. Trainer)
                 from p2p_tpu.core.debug import check_finite
 
                 check_finite(step_metrics, "step_metrics", registry=self.obs)
+            # skipped steps out of the epoch accumulator (cf. Trainer)
+            step_metrics = mask_skipped_metrics(
+                metrics if k > 1 else last, k)
             sums = step_metrics if sums is None else jax.tree_util.tree_map(
                 jnp.add, sums, step_metrics
             )
@@ -298,15 +326,19 @@ class VideoTrainer:
 
         for batch, k in dispatch():
             run(batch, k)
+            # recovery ladder rung 3 (cf. Trainer.train_epoch)
+            if self.health is not None and self.health.rollback_pending:
+                break
             # preemption poll at the step boundary (cf. Trainer.train_epoch)
             if self.preempt is not None and self.preempt.should_stop():
                 self._preempted = True
                 break
+        flush_health_observations(self)
         if sums is None:
             return {}
         host = jax.device_get(sums)
         elapsed = time.perf_counter() - t0
-        out = {k: float(v) / count for k, v in host.items()}
+        out = epoch_metric_means(host, count)
         if count > first_k:
             frames = cfg.data.batch_size * cfg.data.n_frames
             out["frames_per_sec"] = (
@@ -383,44 +415,59 @@ class VideoTrainer:
         cfg = self.cfg
         nepoch = nepoch or cfg.train.nepoch
         history = []
-        first_epoch = self.epoch
+        armed_retrace = False  # armed after the first COMPLETED epoch
         self._preempted = False
         # preemption guard (p2p_tpu.resilience) — same protocol as the
         # image Trainer: flag at the signal, exact-step save + Preempted
         # at the next step boundary, exact-step resume via maybe_resume's
         # skip_batches path.
+        self._host_step = int(np.asarray(jax.device_get(self.state.step)))
         owned_guard = acquire_preempt_guard(self)
         try:
             while self.epoch <= nepoch:
                 skip = self._resume_skip
                 self._resume_skip = 0
+                rollback = False
                 with self.spans.span("epoch", epoch=self.epoch):
                     record = {"epoch": self.epoch,
                               **self.train_epoch(seed=self.epoch,
                                                  skip_batches=skip)}
-                    if cfg.train.eval_every_epoch and not self._preempted:
+                    rollback = (self.health is not None
+                                and self.health.rollback_pending)
+                    if cfg.train.eval_every_epoch and not self._preempted \
+                            and not rollback:
                         record.update(self.evaluate())
                 if self._preempted:
                     finish_preempted(self)  # raises Preempted
+                if rollback:
+                    # ladder rung 3 (cf. Trainer.fit)
+                    perform_rollback(self)
+                    continue
                 history.append(record)
                 self.logger.log({"kind": "epoch", **record}, force=True)
                 self.memwatch.sample(self.logger)
                 if self.plateau is not None and "loss_g" in record:
-                    scale = self.plateau.update(record["loss_g"])
-                    self.state = self.state.replace(
-                        lr_scale=jnp.asarray(scale, jnp.float32)
-                    )
+                    self._base_lr_scale = self.plateau.update(
+                        record["loss_g"])
+                    apply_health_lr(self)
                 if self.epoch % cfg.train.epoch_save == 0 \
                         or self.epoch == nepoch:
                     with self.spans.span("checkpoint_save", epoch=self.epoch):
-                        save_trainer_ckpt(self)
-                if self.epoch == first_epoch:
+                        saved_step = save_trainer_ckpt(self)
+                    psnr = record.get("psnr_mean")
+                    if psnr is not None and np.isfinite(psnr):
+                        self.ckpt.mark_good(saved_step)
+                if not armed_retrace:
                     self.retrace.arm()  # warmup compiles done; see Trainer.fit
+                    armed_retrace = True
                 self.epoch += 1
         finally:
+            # epilogue on every exit — incl. Preempted and exit-76
+            # (cf. Trainer.fit): await async saves, keep the audit trail
             release_preempt_guard(self, owned_guard)
-        self.ckpt.wait()
-        if jax.process_index() == 0:
-            self.spans.export_perfetto(self._trace_path)
-        self.logger.registry.flush()
+            self.ckpt.wait()
+            if jax.process_index() == 0:
+                self.spans.export_perfetto(self._trace_path)
+            log_health_summary(self)
+            self.logger.registry.flush()
         return history

@@ -87,6 +87,12 @@ def create_video_train_state(
     steps_per_epoch: int = 1,
     train_dtype=None,
 ) -> VideoTrainState:
+    if cfg.health.ema_decay is not None:
+        # the VideoTrainState carries no EMA tree (image presets only, like
+        # int8_delayed) — decline loudly rather than silently not smoothing
+        raise ValueError(
+            "health.ema_decay is supported on image presets only (the "
+            "VideoTrainState carries no EMA tree); unset it for video")
     g, d, dt = build_video_models(cfg, train_dtype)
     opt_g, opt_d, opt_dt = make_optimizers(cfg, steps_per_epoch)
 
@@ -249,7 +255,25 @@ def build_video_train_step(
         )
         (grads_g,) = g_vjp(grad_fake)
 
+        # skip guard (health ladder rung 1 — same contract as the image
+        # step): a non-finite step applies NO update to G, D or the
+        # temporal D, and keeps the old BN/spectral state
+        ok = None
+        if cfg.health.enabled:
+            from p2p_tpu.train.state import (
+                health_select,
+                losses_finite,
+                zero_if_unhealthy,
+            )
+
+            ok = losses_finite(loss_g, loss_d, loss_dt)
+            grads_g = zero_if_unhealthy(ok, grads_g)
+            grads_d = zero_if_unhealthy(ok, grads_d)
+            grads_dt = zero_if_unhealthy(ok, grads_dt)
+
         scale = state.lr_scale.astype(jnp.float32)
+        if ok is not None:
+            scale = scale * ok.astype(jnp.float32)
         scale_tree = lambda ups: jax.tree_util.tree_map(  # noqa: E731
             lambda u: u * scale.astype(u.dtype), ups
         )
@@ -259,6 +283,13 @@ def build_video_train_step(
         params_d1 = optax.apply_updates(state.params_d, scale_tree(up_d))
         up_dt, opt_dt1 = opt_dt.update(grads_dt, state.opt_dt, state.params_dt)
         params_dt1 = optax.apply_updates(state.params_dt, scale_tree(up_dt))
+        if ok is not None:
+            opt_g1 = health_select(ok, opt_g1, state.opt_g)
+            opt_d1 = health_select(ok, opt_d1, state.opt_d)
+            opt_dt1 = health_select(ok, opt_dt1, state.opt_dt)
+            bs_g = health_select(ok, bs_g, state.batch_stats_g)
+            spectral2 = health_select(ok, spectral2, state.spectral_d)
+            spectral_t2 = health_select(ok, spectral_t2, state.spectral_dt)
 
         new_state = state.replace(
             step=state.step + 1,
@@ -272,6 +303,8 @@ def build_video_train_step(
             "loss_g": loss_g.astype(jnp.float32),
             **{k: v.astype(jnp.float32) for k, v in g_parts.items()},
         }
+        if ok is not None:
+            metrics["health_ok"] = ok.astype(jnp.float32)
         return new_state, metrics
 
     if jit:

@@ -1,0 +1,785 @@
+"""Self-healing training (p2p_tpu.resilience.health + the wiring through
+train/step.py, train/checkpoint.py, train/loop.py).
+
+Unit level: sentinel classification (robust z-score, NaN on sight),
+ladder escalation/reset/give-up pacing, the widened ``seam@NxM`` chaos
+range, the in-jit skip guard (a non-finite step applies NO update,
+bitwise), EMA generator math (decay-0 parity, blend correctness,
+checkpoint round-trip), checkpoint integrity (corrupt latest step falls
+back to the previous intact step; a fully-corrupt directory raises the
+classified non-retryable CheckpointCorrupt), mark_good/last_good_step.
+
+Integration level (the acceptance pins): an injected NaN at step N walks
+the full ladder — skip, LR cooldown, rollback restoring BITWISE the last
+mark_good step — and training completes; past ``max_rollbacks`` the CLI
+exits with the distinct DIVERGED_EXIT_CODE (76).
+"""
+
+import dataclasses
+import glob
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from p2p_tpu.obs import MetricsRegistry
+from p2p_tpu.resilience import ChaosMonkey, install_chaos
+from p2p_tpu.resilience.health import (
+    DIVERGED_EXIT_CODE,
+    DivergenceError,
+    DivergenceSentinel,
+    RecoveryLadder,
+    TrainingHealth,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos():
+    install_chaos(None)
+    yield
+    install_chaos(None)
+
+
+# ------------------------------------------------------------- sentinel
+
+
+def test_sentinel_healthy_stream_stays_healthy():
+    s = DivergenceSentinel(window=16, spike_zscore=6.0)
+    rng = np.random.default_rng(0)
+    for _ in range(64):
+        m = {"loss_g": 1.0 + 0.05 * rng.standard_normal(),
+             "loss_d": 0.5 + 0.02 * rng.standard_normal()}
+        assert s.classify(m) == "healthy"
+
+
+def test_sentinel_spike_and_nan_classification():
+    s = DivergenceSentinel(window=16, spike_zscore=6.0)
+    rng = np.random.default_rng(1)
+    for _ in range(32):
+        s.classify({"loss_g": 1.0 + 0.05 * rng.standard_normal()})
+    assert s.classify({"loss_g": 50.0}) == "spiking"
+    key, z = s.last_spike
+    assert key == "loss_g" and abs(z) > 6.0
+    # the spike must NOT have entered the window: the next normal value
+    # still reads healthy, and a repeat spike still reads spiking
+    assert s.classify({"loss_g": 1.02}) == "healthy"
+    assert s.classify({"loss_g": 50.0}) == "spiking"
+    # non-finite: diverged on sight, no warm-up needed
+    assert s.classify({"loss_g": float("nan")}) == "diverged"
+    assert s.classify({"loss_g": float("inf")}) == "diverged"
+
+
+def test_sentinel_tracks_slow_drift_without_spiking():
+    """Losses decay over training — a monotone drift must not classify as
+    an endless spike stream (EWMA recentering)."""
+    s = DivergenceSentinel(window=16, spike_zscore=6.0)
+    rng = np.random.default_rng(2)
+    statuses = [s.classify({"loss_g": 10.0 * (0.99 ** i)
+                            + 0.05 * rng.standard_normal()})
+                for i in range(200)]
+    assert statuses.count("spiking") <= 2
+
+
+def test_sentinel_nonfinite_needs_no_warmup():
+    s = DivergenceSentinel()
+    assert s.classify({"loss_g": float("nan")}) == "diverged"
+
+
+# --------------------------------------------------------------- ladder
+
+
+def test_ladder_escalates_skip_cooldown_rollback():
+    reg = MetricsRegistry()
+    lad = RecoveryLadder(cooldown_steps=4, max_rollbacks=3, registry=reg)
+    assert lad.on_status("diverged", step=10) == "skip"
+    assert lad.on_status("diverged", step=11) == "cooldown"
+    assert lad.lr_multiplier == pytest.approx(0.1)
+    assert lad.on_status("diverged", step=12) == "rollback"
+    assert lad.rollback_pending
+    lad.note_rollback_done(step=12, target_step=4)
+    assert not lad.rollback_pending and lad.rollbacks == 1
+    # post-rollback cooldown re-armed
+    assert lad.lr_multiplier == pytest.approx(0.1)
+    assert reg.counter("health_skips_total").value == 1
+    assert reg.counter("health_cooldowns_total").value == 1
+    assert reg.counter("health_rollbacks_total").value == 1
+    assert reg.total("health_spikes_total") == 3
+
+
+def test_ladder_healthy_streak_resets_escalation():
+    lad = RecoveryLadder(cooldown_steps=2, reset_after=3,
+                         registry=MetricsRegistry())
+    assert lad.on_status("spiking", step=1) == "skip"
+    for i in range(3):
+        assert lad.on_status("healthy", step=2 + i) is None
+    # the episode reset: the next spike is rung 1 again, not rung 2
+    assert lad.on_status("spiking", step=9) == "skip"
+
+
+def test_ladder_cooldown_expires_after_n_healthy_steps():
+    lad = RecoveryLadder(cooldown_steps=3, reset_after=100,
+                         registry=MetricsRegistry())
+    lad.on_status("spiking", step=1)
+    lad.on_status("spiking", step=2)  # cooldown armed
+    assert lad.lr_multiplier == pytest.approx(0.1)
+    for i in range(3):
+        lad.on_status("healthy", step=3 + i)
+    assert lad.lr_multiplier == 1.0
+
+
+def test_ladder_gives_up_past_max_rollbacks():
+    lad = RecoveryLadder(max_rollbacks=1, registry=MetricsRegistry())
+    for step in (1, 2):
+        lad.on_status("diverged", step=step)
+    assert lad.on_status("diverged", step=3) == "rollback"
+    lad.note_rollback_done(3, 0)
+    for step in (4, 5):
+        lad.on_status("diverged", step=step)
+    with pytest.raises(DivergenceError) as e:
+        lad.on_status("diverged", step=6)
+    assert e.value.rollbacks == 1 and e.value.step == 6
+    assert DIVERGED_EXIT_CODE == 76
+
+
+def test_training_health_counts_injit_skip_flag():
+    """health_ok == 0 from the in-jit guard counts as an unhealthy event
+    even when the fetched loss values read finite."""
+    cfg = _health_cfg()
+    th = TrainingHealth(cfg.health, registry=MetricsRegistry())
+    assert th.observe(5, {"loss_g": 1.0, "health_ok": 0.0}) == "skip"
+    assert th.observe(6, {"loss_g": 1.0, "health_ok": 1.0}) is None
+
+
+# ----------------------------------------------------- chaos @NxM range
+
+
+def test_chaos_step_range_fires_per_step():
+    m = ChaosMonkey.from_spec("nan@5x3", registry=MetricsRegistry())
+    from p2p_tpu.resilience import FaultInjected
+
+    m.maybe_fail("nan", step=4)            # below range
+    for step in (5, 6, 7):
+        with pytest.raises(FaultInjected):
+            m.maybe_fail("nan", step=step)
+    m.maybe_fail("nan", step=8)            # past range
+    m.maybe_fail("nan", step=6)            # cap consumed
+    assert m.counts() == {"nan": 3}
+
+
+def test_chaos_single_step_target_unchanged():
+    """decode@7 keeps its original meaning: exactly the 7th call, once."""
+    m = ChaosMonkey.from_spec("decode@7", registry=MetricsRegistry())
+    from p2p_tpu.resilience import FaultInjected
+
+    for _ in range(6):
+        m.maybe_fail("decode")
+    with pytest.raises(FaultInjected):
+        m.maybe_fail("decode")
+    m.maybe_fail("decode")
+    assert m.counts() == {"decode": 1}
+
+
+# ------------------------------------------------- in-jit skip guard, EMA
+
+
+def _health_cfg(ema_decay=None, **health_kw):
+    from p2p_tpu.core.config import (
+        Config, DataConfig, HealthConfig, LossConfig, ModelConfig,
+        OptimConfig, ParallelConfig, TrainConfig,
+    )
+    from p2p_tpu.core.mesh import MeshSpec
+
+    return Config(
+        name="health",
+        model=ModelConfig(generator="unet", ngf=4, ndf=4, num_D=1,
+                          n_layers_D=2, use_spectral_norm=False,
+                          use_compression_net=False, use_dropout=True),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        optim=OptimConfig(niter=2, niter_decay=2),
+        data=DataConfig(batch_size=2, image_size=16, threads=0,
+                        uint8_pipeline=False),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+        train=TrainConfig(nepoch=2, epoch_save=1, log_every=100,
+                          mixed_precision=False, seed=0,
+                          eval_every_epoch=True),
+        health=HealthConfig(ema_decay=ema_decay, **health_kw),
+    )
+
+
+def _rand_batch(seed=0, bs=2):
+    rng = np.random.default_rng(seed)
+    return {k: np.asarray(rng.uniform(-1, 1, (bs, 16, 16, 3)), np.float32)
+            for k in ("input", "target")}
+
+
+def _leaves_equal(a, b):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def test_injit_skip_guard_nan_step_is_noop():
+    """THE rung-1 pin: a batch that produces non-finite losses applies NO
+    update — params, optimizer moments, BN stats, spectral state all
+    bitwise-unchanged; only the step counter advances — and the next
+    healthy step trains normally (the moments were not poisoned)."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = _health_cfg()
+    batch = _rand_batch()
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    step = build_train_step(cfg)
+    s1, m1 = step(jax.tree_util.tree_map(jnp.copy, state), dict(batch))
+    assert float(m1["health_ok"]) == 1.0
+
+    nan_batch = {k: np.full_like(v, np.nan) for k, v in batch.items()}
+    s2, m2 = step(jax.tree_util.tree_map(jnp.copy, s1), nan_batch)
+    assert float(m2["health_ok"]) == 0.0
+    for field in ("params_g", "params_d", "opt_g", "opt_d",
+                  "batch_stats_g", "spectral_d", "lr_scale"):
+        assert _leaves_equal(getattr(s1, field), getattr(s2, field)), field
+    assert int(s2.step) == int(s1.step) + 1
+
+    params_before = jax.device_get(s2.params_g)  # s2 is donated below
+    s3, m3 = step(s2, dict(batch))
+    assert float(m3["health_ok"]) == 1.0
+    assert math.isfinite(float(m3["loss_g"]))
+    assert not _leaves_equal(params_before, s3.params_g)
+
+
+def test_injit_guard_disabled_keeps_metrics_clean():
+    """--no-health: no health_ok key, no guard ops in the step."""
+    import jax
+
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = _health_cfg()
+    cfg = cfg.replace(health=dataclasses.replace(cfg.health, enabled=False))
+    batch = _rand_batch()
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    _, metrics = build_train_step(cfg)(state, batch)
+    assert "health_ok" not in metrics
+
+
+def test_ema_decay_zero_tracks_params_bitwise():
+    """The parity pin: at ema_decay=0 the EMA IS the raw params
+    (0·e + 1·p = p), so EMA-eval equals raw-eval bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_eval_step, build_train_step
+
+    cfg = _health_cfg(ema_decay=0.0)
+    batch = _rand_batch()
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    assert state.ema_g is not None
+    step = build_train_step(cfg)
+    for i in range(3):
+        state, _ = step(state, _rand_batch(seed=i))
+    assert _leaves_equal(state.ema_g, state.params_g)
+
+    # eval through the EMA slot == eval through raw params, bitwise
+    from p2p_tpu.train.loop import eval_state_of
+
+    class _T:  # minimal eval_state_of carrier
+        pass
+
+    t = _T()
+    t.state = state
+    est = eval_state_of(t)
+    ev = build_eval_step(cfg)
+    pred_ema, met_ema = ev(est, batch)
+    pred_raw, met_raw = ev(state, batch)
+    assert np.array_equal(np.asarray(pred_ema), np.asarray(pred_raw))
+    assert np.array_equal(np.asarray(met_ema["psnr"]),
+                          np.asarray(met_raw["psnr"]))
+
+
+def test_ema_blend_math_and_checkpoint_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = _health_cfg(ema_decay=0.5)
+    batch = _rand_batch()
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    s1, _ = build_train_step(cfg)(
+        jax.tree_util.tree_map(jnp.copy, state), batch)
+    # one step from ema==params0: ema1 = 0.5·params0 + 0.5·params1
+    want = jax.tree_util.tree_map(
+        lambda e, p: 0.5 * np.asarray(e) + 0.5 * np.asarray(p),
+        state.params_g, s1.params_g)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.ema_g),
+                    jax.tree_util.tree_leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1e-6, atol=1e-7)
+
+    m = CheckpointManager(str(tmp_path / "ck"))
+    m.save(1, s1, wait=True)
+    restored = m.restore(s1, 1)
+    m.close()
+    assert _leaves_equal(restored.ema_g, s1.ema_g)
+
+
+def test_ema_off_keeps_checkpoint_tree_unchanged(tmp_path):
+    """ema_decay=None leaves ema_g=None — an empty subtree, so a
+    pre-EMA checkpoint restores into the new TrainState bit-for-bit."""
+    import jax
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.state import create_train_state
+
+    cfg = _health_cfg()
+    state = create_train_state(cfg, jax.random.key(0), _rand_batch())
+    assert state.ema_g is None
+    m = CheckpointManager(str(tmp_path / "ck"))
+    m.save(1, state, wait=True)
+    restored = m.restore(state, 1)
+    m.close()
+    assert _leaves_equal(restored, state)
+
+
+def test_video_and_pp_decline_ema_loudly():
+    import jax
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.train.step import build_pp_train_step
+    from p2p_tpu.train.video_step import create_video_train_state
+
+    vcfg = get_preset("vid2vid_temporal")
+    vcfg = vcfg.replace(health=dataclasses.replace(vcfg.health,
+                                                   ema_decay=0.9))
+    with pytest.raises(ValueError, match="image presets only"):
+        create_video_train_state(vcfg, jax.random.key(0), {})
+
+    pcfg = get_preset("reference")
+    pcfg = pcfg.replace(health=dataclasses.replace(pcfg.health,
+                                                   ema_decay=0.9))
+    with pytest.raises(ValueError, match="unpipelined"):
+        build_pp_train_step(pcfg, mesh=None, n_micro=2)
+
+
+# --------------------------------------- checkpoint integrity + last-good
+
+
+def _corrupt_step_arrays(ckpt_dir, step):
+    """Flip bytes in the step's ARRAY data files (not the metadata/json —
+    the checksum path must catch silent data corruption, not just
+    unparseable checkpoints)."""
+    hit = 0
+    for f in glob.glob(os.path.join(ckpt_dir, str(step), "**"),
+                       recursive=True):
+        base = os.path.basename(f)
+        if (os.path.isfile(f) and os.path.getsize(f) > 256
+                and not base.endswith((".json", "manifest.ocdbt"))
+                and "metadata" not in base and "manifest" not in f):
+            with open(f, "r+b") as fh:
+                fh.seek(os.path.getsize(f) // 2)
+                fh.write(b"\xde\xad\xbe\xef" * 16)
+            hit += 1
+    return hit
+
+
+def test_corrupt_latest_falls_back_to_intact_step(tmp_path):
+    """Satellite pin: truncate/corrupt the latest step's arrays on disk;
+    restore logs the mismatch (counter + kind=ckpt_corrupt record) and
+    transparently falls back to the previous intact step."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    reg = MetricsRegistry()
+    recs = []
+    reg.add_sink(type("S", (), {
+        "write": lambda self, r, force=False: recs.append(r),
+        "flush": lambda self: None, "close": lambda self: None})())
+    m = CheckpointManager(str(tmp_path / "ck"), registry=reg)
+    s_old = {"a": jnp.arange(512.0), "b": jnp.ones((32, 32))}
+    s_new = {"a": jnp.arange(512.0) * 2, "b": jnp.full((32, 32), 3.0)}
+    m.save(1, s_old, wait=True)
+    m.save(2, s_new, wait=True)
+    assert _corrupt_step_arrays(str(tmp_path / "ck"), 2) > 0
+
+    restored = m.restore(s_new)  # latest (2) corrupt -> falls back to 1
+    assert np.array_equal(np.asarray(restored["a"]), np.arange(512.0))
+    assert reg.counter("ckpt_corrupt_total").value >= 1
+    assert any(r.get("kind") == "ckpt_corrupt" and r["step"] == 2
+               for r in recs)
+    m.close()
+
+
+def test_fully_corrupt_directory_raises_classified_nonretryable(tmp_path):
+    """Satellite pin: every step corrupt -> CheckpointCorrupt, which the
+    retry layer classifies NON-retryable (no retry-forever on rot)."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.resilience.retry import CKPT_POLICY
+    from p2p_tpu.train.checkpoint import CheckpointCorrupt, CheckpointManager
+
+    m = CheckpointManager(str(tmp_path / "ck"), registry=MetricsRegistry())
+    s = {"a": jnp.arange(512.0)}
+    m.save(1, s, wait=True)
+    m.save(2, s, wait=True)
+    for step in (1, 2):
+        assert _corrupt_step_arrays(str(tmp_path / "ck"), step) > 0
+    with pytest.raises(CheckpointCorrupt) as e:
+        m.restore(s)
+    assert e.value.tried == [2, 1]
+    assert not CKPT_POLICY.is_retryable(e.value)
+    m.close()
+
+
+def test_chaos_ckpt_corrupt_seam_forces_fallback(tmp_path):
+    """The ckpt_corrupt seam rehearses the fallback without touching
+    disk: the armed verify treats the step as mismatched."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    m = CheckpointManager(str(tmp_path / "ck"), registry=MetricsRegistry())
+    m.save(1, {"a": jnp.zeros(8)}, wait=True)
+    m.save(2, {"a": jnp.ones(8)}, wait=True)
+    install_chaos(ChaosMonkey.from_spec("ckpt_corrupt@2",
+                                        registry=MetricsRegistry()))
+    restored = m.restore({"a": jnp.zeros(8)})
+    assert np.array_equal(np.asarray(restored["a"]), np.zeros(8))
+    m.close()
+
+
+def test_mark_good_and_last_good_step(tmp_path):
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    m = CheckpointManager(str(tmp_path / "ck"), registry=MetricsRegistry())
+    assert m.last_good_step() is None
+    m.save(4, {"a": jnp.zeros(4)}, wait=True)
+    m.save(8, {"a": jnp.ones(4)}, wait=True)
+    m.mark_good(4)
+    assert m.last_good_step() == 4
+    m.mark_good(8)
+    assert m.last_good_step() == 8
+    # a marker for a step that no longer exists on disk is ignored
+    m.mark_good(99)
+    assert m.last_good_step() == 8
+    m.close()
+
+
+def test_mask_skipped_metrics_keeps_epoch_means_finite():
+    """A skipped (NaN) step must not poison the epoch-sum averages or the
+    plateau controller fed from them: skipped rows zero out of the
+    accumulator and the means divide by the APPLIED step count."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.loop import epoch_metric_means, mask_skipped_metrics
+
+    m = {"loss_g": jnp.array([1.0, float("nan"), 3.0]),
+         "health_ok": jnp.array([1.0, 0.0, 1.0])}
+    s = mask_skipped_metrics(m, 3)
+    assert float(s["loss_g"]) == 4.0
+    assert float(s["health_ok"]) == 2.0
+    out = epoch_metric_means({k: float(v) for k, v in s.items()}, 3)
+    assert out["loss_g"] == 2.0                      # mean over APPLIED
+    assert out["health_ok"] == pytest.approx(2 / 3)  # fraction over ALL
+    # guard off (no health_ok): the plain scan-axis sum as before
+    s2 = mask_skipped_metrics({"loss_g": jnp.array([1.0, 2.0])}, 2)
+    assert float(s2["loss_g"]) == 3.0
+
+
+def test_explicit_corrupt_step_raises_no_silent_fallback(tmp_path):
+    """An operator-pinned --step that exists but fails integrity must
+    RAISE, not silently serve older weights; the unnamed-latest path
+    keeps the fallback."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointCorrupt, CheckpointManager
+
+    m = CheckpointManager(str(tmp_path / "ck"), registry=MetricsRegistry())
+    m.save(1, {"a": jnp.arange(512.0)}, wait=True)
+    m.save(2, {"a": jnp.arange(512.0) * 2}, wait=True)
+    assert _corrupt_step_arrays(str(tmp_path / "ck"), 2) > 0
+    with pytest.raises(CheckpointCorrupt) as e:
+        m.restore({"a": jnp.zeros(512)}, step=2)
+    assert e.value.tried == [2]
+    # unnamed restore still heals to the intact older step
+    r = m.restore({"a": jnp.zeros(512)})
+    assert np.array_equal(np.asarray(r["a"]), np.arange(512.0))
+    # rollback-style explicit restore opts back into the fallback
+    r2 = m.restore({"a": jnp.zeros(512)}, step=2, fallback=True)
+    assert np.array_equal(np.asarray(r2["a"]), np.arange(512.0))
+    m.close()
+
+
+def test_ema_over_pre_ema_checkpoint_diagnosed(tmp_path, monkeypatch):
+    """Adding --ema_decay over a checkpoint saved WITHOUT the EMA tree
+    must fail with a clear 'resume without --ema_decay' diagnosis, not a
+    misleading CheckpointCorrupt."""
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "d"), 8, 2, size=16)
+    wd = str(tmp_path / "w")
+    tr = Trainer(_health_cfg(), data_root=root, workdir=wd)
+    try:
+        tr.fit(nepoch=1)
+    finally:
+        tr.close()
+
+    tr2 = Trainer(_health_cfg(ema_decay=0.999), data_root=root, workdir=wd)
+    try:
+        with pytest.raises(RuntimeError, match="without --ema_decay"):
+            tr2.maybe_resume()
+    finally:
+        tr2.close()
+
+
+def test_duplicate_step_save_keeps_manifest_consistent(tmp_path):
+    """Saving an already-held step is a no-op on disk (Orbax keeps the
+    original bytes) — the integrity manifest must keep describing the
+    ORIGINAL, or the next restore reads a false corruption."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    reg = MetricsRegistry()
+    m = CheckpointManager(str(tmp_path / "ck"), registry=reg)
+    m.save(1, {"a": jnp.zeros(8)}, wait=True)
+    m.save(1, {"a": jnp.ones(8)}, wait=True)  # duplicate: disk unchanged
+    restored = m.restore({"a": jnp.zeros(8)}, 1)
+    assert np.array_equal(np.asarray(restored["a"]), np.zeros(8))
+    assert reg.counter("ckpt_corrupt_total").value == 0
+    m.close()
+
+
+def test_dtype_cast_restore_is_not_flagged_corrupt(tmp_path):
+    """An old f32-moment checkpoint restoring into a bf16 template casts
+    bytes legitimately — the verifier must skip dtype-changed leaves."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    reg = MetricsRegistry()
+    m = CheckpointManager(str(tmp_path / "ck"), registry=reg)
+    m.save(1, {"a": jnp.arange(64, dtype=jnp.float32)}, wait=True)
+    restored = m.restore({"a": jnp.zeros(64, dtype=jnp.bfloat16)}, 1)
+    assert restored["a"].dtype == jnp.bfloat16
+    assert reg.counter("ckpt_corrupt_total").value == 0
+    m.close()
+
+
+# ------------------------------------------- trainer-level integration
+
+
+def test_rollback_restores_marked_step_bitwise(tmp_path, monkeypatch):
+    """Drive the ladder to rung 3 by hand and pin the contract: after
+    perform_rollback the live TrainState is BITWISE the mark_good
+    checkpoint, the shuffle seed is perturbed, and the cooldown is armed."""
+    import jax
+
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer, perform_rollback
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "d"), 8, 2, size=16)
+    cfg = _health_cfg(cooldown_steps=2)
+    tr = Trainer(cfg, data_root=root, workdir=str(tmp_path / "w"))
+    try:
+        tr.fit(nepoch=1)  # epoch 1: ckpt at step 4, eval-marked good
+        assert tr.ckpt.last_good_step() == 4
+        golden = jax.device_get(tr.ckpt.restore(tr.state, 4))
+        # poison the live state a bit, then walk the ladder to rollback
+        for step, _ in zip((5, 6, 7), range(3)):
+            tr.health.observe(step, {"loss_g": float("nan")})
+        assert tr.health.rollback_pending
+        jitter0 = tr._seed_jitter
+        perform_rollback(tr)
+        # bitwise the marked checkpoint — except lr_scale, which the
+        # post-rollback cooldown INTENTIONALLY scales down (rung 2 re-arms
+        # so the restored state re-enters its regime on a gentler LR)
+        import jax.numpy as jnp
+
+        rolled = jax.device_get(tr.state)
+        assert float(rolled.lr_scale) == pytest.approx(0.1)
+        assert _leaves_equal(
+            rolled.replace(lr_scale=jnp.ones((), jnp.float32)),
+            golden.replace(lr_scale=jnp.ones((), jnp.float32)))
+        assert tr._seed_jitter != jitter0
+        assert tr.health.lr_multiplier == pytest.approx(0.1)
+        assert tr.epoch == 2 and tr._resume_skip == 0
+    finally:
+        tr.close()
+
+
+def test_resume_follows_integrity_fallback_step(tmp_path, monkeypatch):
+    """A corrupt LATEST checkpoint at resume time: maybe_resume must do
+    its position bookkeeping (epoch, host step) against the step the
+    fallback ACTUALLY restored, not the latest step it asked for."""
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "d"), 8, 2, size=16)
+    cfg = _health_cfg()
+    wd = str(tmp_path / "w")
+    tr = Trainer(cfg, data_root=root, workdir=wd)
+    try:
+        tr.fit()  # nepoch=2, epoch_save=1 -> checkpoints at steps 4 and 8
+    finally:
+        tr.close()
+    ck_dir = os.path.join(wd, "checkpoint", "facades", "health")
+    assert _corrupt_step_arrays(ck_dir, 8) > 0
+
+    tr2 = Trainer(cfg, data_root=root, workdir=wd)
+    try:
+        assert tr2.maybe_resume()
+        assert tr2.ckpt.last_restored_step == 4
+        assert tr2._host_step == 4 and tr2.epoch == 2
+        assert tr2._resume_skip == 0
+    finally:
+        tr2.close()
+
+
+def test_resume_restores_lr_base_and_seed_jitter(tmp_path, monkeypatch):
+    """A preemption save mid-cooldown must not make the transient 10x LR
+    reduction permanent, and the rollback shuffle perturbation must
+    survive the relaunch (both ride the sidecar)."""
+    import jax
+
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer, apply_health_lr, save_trainer_ckpt
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "d"), 8, 2, size=16)
+    cfg = _health_cfg(cooldown_steps=8)
+    wd = str(tmp_path / "w")
+    tr = Trainer(cfg, data_root=root, workdir=wd)
+    try:
+        tr.fit(nepoch=1)
+        # simulate: one rollback happened (jitter set) and a cooldown is
+        # ACTIVE when the next save lands (preemption mid-cooldown)
+        tr._seed_jitter = 1000003
+        tr.health.ladder.on_status("spiking", step=4)
+        tr.health.ladder.on_status("spiking", step=5)  # arms the cooldown
+        apply_health_lr(tr)
+        assert float(jax.device_get(tr.state.lr_scale)) == pytest.approx(0.1)
+        # one more epoch trains UNDER the cooldown, then a NEW step (8)
+        # is saved with the reduced lr_scale frozen into the state
+        tr.epoch = 2
+        tr.train_epoch(seed=2)
+        save_trainer_ckpt(tr, wait=True)
+        assert int(tr.state.step) == 8
+    finally:
+        tr.close()
+
+    tr2 = Trainer(cfg, data_root=root, workdir=wd)
+    try:
+        assert tr2.maybe_resume()
+        # base restored (cooldown is transient), jitter re-derived
+        assert float(jax.device_get(tr2.state.lr_scale)) == 1.0
+        assert tr2._base_lr_scale == 1.0
+        assert tr2._seed_jitter == 1000003
+    finally:
+        tr2.close()
+
+
+def test_nan_chaos_walks_full_ladder_and_completes(tmp_path, monkeypatch):
+    """THE acceptance pin: nan@6x3 -> skip at 6, cooldown at 7, rollback
+    at 8 targeting the eval-validated step 4 — and the run still
+    completes every epoch with continuous step accounting."""
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.train.loop import Trainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "d"), 8, 2, size=16)
+    cfg = _health_cfg(cooldown_steps=2, reset_after=4, max_rollbacks=2)
+    cfg = cfg.replace(train=dataclasses.replace(cfg.train, nepoch=3))
+    install_chaos(ChaosMonkey.from_spec("nan@6x3"))
+    wd = str(tmp_path / "w")
+    tr = Trainer(cfg, data_root=root, workdir=wd)
+    try:
+        hist = tr.fit()
+    finally:
+        tr.close()
+    assert [h["epoch"] for h in hist] == [1, 2, 3]
+    assert int(tr.state.step) == 12
+    recs = [json.loads(l) for l in open(os.path.join(wd,
+                                                     "metrics_health.jsonl"))]
+    actions = [r.get("action") for r in recs if r.get("kind") == "health"]
+    assert actions[:3] == ["skip", "cooldown", "rollback"]
+    rb = [r for r in recs if r.get("kind") == "rollback"]
+    assert rb and rb[0]["target_step"] == 4 and rb[0]["step"] == 8
+    summ = [r for r in recs if r.get("kind") == "health_summary"][-1]
+    assert summ["health_rollbacks_total"] == 1
+    assert summ["health_skips_total"] == 1
+
+
+def test_ladder_exhaustion_exits_76(tmp_path, monkeypatch):
+    """Past max_rollbacks the run gives up with DivergenceError and the
+    CLI maps it to the DISTINCT exit code 76 (not preemption's 75)."""
+    from p2p_tpu.cli.train import main
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    # every step after warm-up poisoned: rollback budget 0 -> giveup at
+    # the third unhealthy observation
+    monkeypatch.setenv("P2P_CHAOS", "nan:1.0")
+    install_chaos(None)  # reset the env latch so P2P_CHAOS re-arms
+    root = make_synthetic_dataset(str(tmp_path / "d"), 8, 2, size=16)
+    rc = main([
+        "--preset", "facades", "--data_root", root,
+        "--workdir", str(tmp_path / "w"), "--name", "give",
+        "--dataset", "gs", "--image_size", "16", "--batch_size", "2",
+        "--test_batch_size", "2", "--ngf", "4", "--ndf", "4",
+        "--threads", "0", "--nepoch", "2", "--niter", "1",
+        "--niter_decay", "1", "--epochsave", "1", "--seed", "0",
+        "--lambda_vgg", "0", "--max_rollbacks", "0", "--log_every", "100",
+    ])
+    assert rc == DIVERGED_EXIT_CODE == 76
+
+
+def test_serve_engine_uses_ema_weights(tmp_path):
+    """engine_from_checkpoint swaps the restored EMA weights in for
+    params_g; at ema_decay=0 the served output is bitwise the raw-params
+    output (the serve-side parity pin)."""
+    import jax
+
+    from p2p_tpu.serve.engine import engine_from_checkpoint
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = _health_cfg(ema_decay=0.0)
+    batch = _rand_batch()
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    state, _ = build_train_step(cfg)(state, batch)
+    ck = str(tmp_path / "ck")
+    m = CheckpointManager(ck)
+    m.save(1, state, wait=True)
+    m.close()
+
+    eng_ema, step = engine_from_checkpoint(cfg, ck, batch, buckets=(2,))
+    assert step == 1
+    assert eng_ema.state.ema_g is None  # swapped into params_g
+    raw_cfg = cfg.replace(health=dataclasses.replace(cfg.health,
+                                                     ema_decay=None))
+    eng_raw, _ = engine_from_checkpoint(raw_cfg, ck, batch, buckets=(2,))
+    pred_ema, _, n = eng_ema.infer_batch(batch)
+    pred_raw, _, _ = eng_raw.infer_batch(batch)
+    assert n == 2
+    assert np.array_equal(np.asarray(pred_ema), np.asarray(pred_raw))

@@ -610,7 +610,8 @@ def test_preempt_writes_sidecar_and_metrics_record(tmp_path, monkeypatch):
     aux = ck.restore_aux(3)
     ck.close()
     assert aux == {"step": 3, "epoch": 1, "batches_done": 3,
-                   "steps_per_epoch": 4, "aug_seed": 1}
+                   "steps_per_epoch": 4, "aug_seed": 1,
+                   "seed_jitter": 0, "lr_base": 1.0}
     kinds = [json.loads(line) for line in
              open(os.path.join(wd, "metrics_exact.jsonl"))]
     pre = [r for r in kinds if r.get("kind") == "preempt"]
