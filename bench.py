@@ -24,13 +24,21 @@ Env knobs: BENCH_PRESET, BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG;
 BENCH_JSONL=<path> additionally appends the record (kind="bench") to that
 metrics stream through the obs registry.
 
-``--sweep`` runs the six BASELINE.md contract rows (headline, bs=1,
-edges2shoes int8-delayed, cityscapes, pix2pixhd, vid2vid) and diffs each
-against the last-recorded band, exiting nonzero on a >3% regression below
-the band floor — the standing perf-regression gate (VERDICT r5 #7).
+``--sweep`` runs the eight BASELINE.md contract rows (headline, bs=1,
+edges2shoes int8-delayed, cityscapes, pix2pixhd, vid2vid, the round-6
+int8-multiscale-D and pallas-fusion rows) and diffs each against the
+last-recorded band, exiting nonzero on a >3% regression below the band
+floor — the standing perf-regression gate (VERDICT r5 #7). New rows carry
+``band: None`` until their first on-TPU recording lands in BASELINE.md.
 ``--sweep --dry-run`` shrinks every row to toy dims and skips the band
 check: a CPU-able plumbing test that each contract config still builds,
 steps, and reports (CI runs it).
+
+Every image-preset record additionally carries a fenced per-net ``phases``
+breakdown (``_phase_breakdown``: G/D/C fwd+bwd ms via ``StepTimer.chain``,
+one dispatch per net, outside the headline timing) so a lever's win — or
+the remaining gap to the 2000 img/s north star — is attributable to its
+net rather than only the headline number. ``BENCH_BREAKDOWN=0`` skips it.
 
 ``--infer`` is the standing INFERENCE headline row: the serving engine
 (p2p_tpu.serve — AOT bucket-batched generator inference with pipelined
@@ -66,6 +74,99 @@ import dataclasses
 import json
 import os
 import sys
+
+
+def _phase_breakdown(cfg, state, host_batch, dtype, scan_k, rtt) -> dict:
+    """Fenced per-net (G/D/C) fwd+bwd timings — the attribution layer the
+    sweep records carry so a lever's win (int8-D, Pallas fusion, ...) shows
+    up against ITS net, not just the headline number (BENCH_r06+).
+
+    Each net gets its own jitted ``lax.scan`` of ``scan_k`` value_and_grad
+    iterations (chained through the carry so XLA cannot hoist the loop
+    body), timed with the same ``StepTimer.chain`` + RTT methodology as the
+    headline — one fenced dispatch per net. Numbers are ms per iteration:
+    ONE forward+backward of that net alone (the D figure is one D pass;
+    the train step runs two — fake and real). They are attribution
+    weights, not an additive decomposition of the step (the real step
+    fuses cross-net work the isolated programs cannot)."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.obs import StepTimer, span
+    from p2p_tpu.train.state import build_models
+    from p2p_tpu.utils.images import ingest
+
+    g, d, c = build_models(cfg, dtype)
+    real_a = ingest(jnp.asarray(host_batch["input"]), dtype)
+    real_b = ingest(jnp.asarray(host_batch["target"]), dtype)
+    use_quant = cfg.model.int8_delayed
+
+    g_vars = {"params": 0, "batch_stats": state.batch_stats_g}
+    if use_quant:
+        g_vars["quant"] = state.quant_g
+
+    def g_loss(params, x):
+        vars_ = dict(g_vars, params=params)
+        out = g.apply(vars_, x, False)
+        return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+    d_vars = {"spectral": state.spectral_d}
+    if use_quant:
+        d_vars["quant"] = state.quant_d
+    if cfg.model.split_d_pairs:
+        pair = (real_a, real_b)
+    else:
+        pair = jnp.concatenate([real_a, real_b], axis=-1)
+
+    def d_loss(params, x):
+        preds = d.apply({"params": params, **d_vars}, x)
+        return sum(jnp.mean(jnp.square(p.astype(jnp.float32)))
+                   for p in jax.tree_util.tree_leaves(preds))
+
+    def c_loss(params, x):
+        out = c.apply({"params": params,
+                       "batch_stats": state.batch_stats_c}, x, False)
+        return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+    def perturb(x, eps):
+        # thread the scan carry into the input so the loop body genuinely
+        # depends on the previous iteration (XLA would hoist an invariant
+        # body out of the while loop and time nothing)
+        if isinstance(x, tuple):
+            return (x[0] + eps.astype(x[0].dtype), x[1])
+        return x + eps.astype(x.dtype)
+
+    def timed_ms(name, loss_fn, params, x):
+        # params/x enter as jit ARGUMENTS (not closure constants): the
+        # program is value-independent, so it can hit the persistent XLA
+        # cache across runs and never embeds weight blobs in the HLO
+        def prog_fn(p, xx):
+            def body(carry, _):
+                val, grads = jax.value_and_grad(loss_fn)(
+                    p, perturb(xx, carry * 1e-30))
+                leaf = jax.tree_util.tree_leaves(grads)[0]
+                return (val + leaf.reshape(-1)[0].astype(jnp.float32) * 0.0,
+                        None)
+
+            return jax.lax.scan(body, jnp.zeros((), jnp.float32), None,
+                                length=scan_k)
+
+        prog = jax.jit(prog_fn)
+        with span(f"bench_phase_{name}_warmup"):
+            out, _ = prog(params, x)
+            float(out)                      # compile + fence
+        t = StepTimer(batch_size=1)
+        with span(f"bench_phase_{name}"), t.chain(steps=scan_k,
+                                                  rtt=rtt) as ch:
+            out, _ = prog(params, x)
+            ch.fence(out)
+        return round(t.elapsed / scan_k * 1000.0, 3)
+
+    phases = {"g_ms": timed_ms("g", g_loss, state.params_g, real_a),
+              "d_ms": timed_ms("d", d_loss, state.params_d, pair)}
+    if cfg.model.use_compression_net:
+        phases["c_ms"] = timed_ms("c", c_loss, state.params_c, real_b)
+    return phases
 
 
 def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
@@ -180,6 +281,21 @@ def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8=True, int8_generator=True, int8_decoder=True))
         preset = preset + "_i8dec"
+    if os.environ.get("BENCH_NORM", ""):
+        # generator norm override — BENCH_NORM=pallas_instance routes the
+        # norm→act(→residual) chains through the fused Pallas epilogue
+        # (ops/pallas/norm_act.py; lax fallback off-TPU)
+        val = os.environ["BENCH_NORM"]
+        cfg = cfg.replace(model=dataclasses.replace(cfg.model, norm=val))
+        preset = preset + {"pallas_instance": "_pnorm",
+                           "instance": "_inorm"}.get(val, "_" + val)
+    if os.environ.get("BENCH_NORMD", ""):
+        # discriminator-side norm (ModelConfig.norm_d — pix2pixHD-paper D
+        # layout; pallas_instance = fused norm+LeakyReLU epilogue)
+        val = os.environ["BENCH_NORMD"]
+        cfg = cfg.replace(model=dataclasses.replace(cfg.model, norm_d=val))
+        preset = preset + {"pallas_instance": "_pnormd",
+                           "instance": "_inormd"}.get(val, "_" + val + "d")
     dtype = jnp.bfloat16 if cfg.train.mixed_precision else None
 
     n_frames = cfg.data.n_frames
@@ -286,6 +402,15 @@ def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
             sentinel_feed(pend)
         ch.fence(metrics["loss_g"][-1])  # forces the whole chained sequence
 
+    # per-net attribution breakdown (OUTSIDE the timed headline chain, so
+    # the headline number is untouched); BENCH_BREAKDOWN=0 skips it. Video
+    # presets keep headline-only records (their nets differ per step).
+    phases = None
+    if os.environ.get("BENCH_BREAKDOWN", "1") == "1" and n_frames == 1:
+        phases = _phase_breakdown(cfg, state, host, dtype, scan_k, rtt)
+        phases["step_ms"] = round(
+            timer.elapsed / max(timer.intervals, 1) * 1000.0, 3)
+
     img_per_sec = timer.images_per_sec
     baseline = 2000.0  # BASELINE.json north_star: img/s/chip @ 256^2 pix2pix
     comparable = on_tpu and img == 256 and preset in (
@@ -304,6 +429,8 @@ def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
     }
     if sentinel is not None:
         record["sentinel"] = dict(sentinel_stats)
+    if phases is not None:
+        record["phases"] = phases
     if comparable:
         # context: the 2000 img/s north star was set for TPU v4 (275 bf16
         # peak TF/s); this driver measures whatever chip the tunnel exposes.
@@ -431,10 +558,13 @@ def run_infer(tiny: bool = False) -> dict:
 # --sweep: the standing perf-regression gate (VERDICT r5 #7)
 # ---------------------------------------------------------------------------
 
-# The six contract rows with BASELINE.md's last-recorded bands
+# The eight contract rows with BASELINE.md's last-recorded bands
 # (img/s/chip; round-5 ledger + session-2 final-tree regression sweep).
 # A row regresses when it lands >3% below its band FLOOR — the band width
-# itself is documented tunnel/day drift, not regression.
+# itself is documented tunnel/day drift, not regression. ``band: None`` =
+# a new row whose band is pending its first on-TPU recording (BASELINE.md
+# "adding a band"): the row runs and reports, the regression gate arms
+# once the measured band is written here.
 SWEEP_ROWS = [
     {"name": "headline_facades_int8_bs128", "env": {},
      "band": (1684.4, 1717.2)},
@@ -450,6 +580,17 @@ SWEEP_ROWS = [
      "band": (8.77, 8.81)},
     {"name": "vid2vid_temporal",
      "env": {"BENCH_PRESET": "vid2vid_temporal"}, "band": (200.3, 203.5)},
+    # round-6 rows (ISSUE 6): int8 over the FULL 3-scale spectral-norm
+    # multiscale D (the reference workload's D, delayed scales), and the
+    # fused Pallas norm+act chains on the instance-norm ResNet family
+    {"name": "reference_int8_multiD",
+     "env": {"BENCH_PRESET": "reference", "BENCH_INT8": "1",
+             "BENCH_DELAYED": "1"},
+     "band": None},
+    {"name": "cityscapes_pallas_fused",
+     "env": {"BENCH_PRESET": "cityscapes_spatial",
+             "BENCH_NORM": "pallas_instance"},
+     "band": None},
 ]
 
 REGRESSION_TOLERANCE = 0.03
@@ -469,7 +610,7 @@ def run_sweep(dry_run: bool = False) -> int:
     # the sweep owns these knobs; a stray env override would silently
     # bench a different contract than the bands record
     owned = ("BENCH_PRESET", "BENCH_BS", "BENCH_INT8", "BENCH_DELAYED",
-             "BENCH_IMG")
+             "BENCH_IMG", "BENCH_NORM", "BENCH_NORMD", "BENCH_BREAKDOWN")
     saved = {k: os.environ.pop(k) for k in owned if k in os.environ}
     if saved:
         print(f"note: ignoring {sorted(saved)} for --sweep",
@@ -484,19 +625,26 @@ def run_sweep(dry_run: bool = False) -> int:
             finally:
                 for k in row["env"]:
                     os.environ.pop(k, None)
-            lo, hi = row["band"]
-            status = "ok"
+            band = row["band"]
+            status = "ok" if band is not None else "ok (band pending)"
             if not (rec["value"] > 0):
                 status = "failed"
-                regressions.append((row["name"], rec["value"], lo))
-            elif check_bands:
+                regressions.append((row["name"], rec["value"],
+                                    band[0] if band else 0.0))
+            elif check_bands and band is not None:
+                lo = band[0]
                 floor = lo * (1 - REGRESSION_TOLERANCE)
                 if rec["value"] < floor:
                     status = f"REGRESSION (<{floor:.1f})"
                     regressions.append((row["name"], rec["value"], lo))
-            results.append({"row": row["name"], "value": rec["value"],
-                            "band": [lo, hi], "status": status,
-                            "metric": rec["metric"]})
+            entry = {"row": row["name"], "value": rec["value"],
+                     "band": list(band) if band is not None else None,
+                     "status": status, "metric": rec["metric"]}
+            if "phases" in rec:
+                # the per-net attribution breakdown rides every sweep row
+                # (ISSUE 6 satellite — see _phase_breakdown)
+                entry["phases"] = rec["phases"]
+            results.append(entry)
             print(json.dumps(results[-1]), flush=True)
     finally:
         os.environ.update(saved)
@@ -518,8 +666,9 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sweep", action="store_true",
-                    help="run all six BASELINE.md contract rows and fail "
-                         "on >3% regression below the recorded band")
+                    help="run all eight BASELINE.md contract rows and fail "
+                         "on >3% regression below the recorded band "
+                         "(band-less round-6 rows report without gating)")
     ap.add_argument("--infer", action="store_true",
                     help="bench the serving engine instead of the train "
                          "step: AOT bucket-batched inference + pipelined "

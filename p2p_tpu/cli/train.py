@@ -67,6 +67,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "RESUME pre-round-3 facades_int8 checkpoints — "
                         "the quant collection changes the TrainState "
                         "tree)")
+    p.add_argument("--norm_d", type=str, default=None,
+                   choices=["none", "instance", "pallas_instance"],
+                   help="discriminator-side norm on the inner PatchGAN "
+                        "convs (pix2pixHD-paper D layout; affine-free, so "
+                        "checkpoints interchange with 'none'). "
+                        "'pallas_instance' fuses norm+LeakyReLU into one "
+                        "Pallas pass (ops/pallas/norm_act.py)")
+    p.add_argument("--pp_overlap", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="latency-hiding GPipe schedule: the stage hand-off "
+                        "ppermute is double-buffered so the transfer "
+                        "overlaps stage compute (parallel/pp.py; costs S-1 "
+                        "extra fill/drain ticks — see docs/PARALLELISM.md)")
     p.add_argument("--thin_head", action="store_true", default=None,
                    help="U-Net image head as the subpixel form (k2s1 "
                         "conv + interleave; measured a wash on v5e, "
@@ -225,7 +238,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  int8_generator=args.int8_generator,
                  int8_delayed=args.int8_delayed,
                  legacy_layout=args.legacy_layout,
-                 thin_head=args.thin_head)
+                 thin_head=args.thin_head, norm_d=args.norm_d)
     loss = over(loss, lambda_l1=args.lamb, lambda_vgg=args.lambda_vgg,
                 lambda_feat=args.lambda_feat, lambda_tv=args.lambda_tv,
                 lambda_sobel=args.lambda_sobel,
@@ -259,7 +272,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                   spike_zscore=args.spike_zscore,
                   cooldown_steps=args.cooldown_steps,
                   window=args.health_window)
-    par = over(par, tp_min_ch=args.tp_min_ch)
+    par = over(par, tp_min_ch=args.tp_min_ch, pp_overlap=args.pp_overlap)
     if args.mesh is not None:
         from p2p_tpu.core.mesh import MeshSpec
 

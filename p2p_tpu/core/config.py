@@ -47,6 +47,14 @@ class ModelConfig:
     quant_ste: bool = True
     # "batch" | "instance" | "pallas_instance"
     norm: str = "batch"
+    # Discriminator-side normalization on the inner PatchGAN convs:
+    # "none" (reference parity — networks.py:716 has no D norms) |
+    # "instance" | "pallas_instance" (the pix2pixHD paper's D layout;
+    # stateless/affine-free, so the param tree — and therefore
+    # checkpoints — are identical either way). With "pallas_instance"
+    # the conv epilogue (norm + LeakyReLU) is ONE fused Pallas pass
+    # (ops/pallas/norm_act.py).
+    norm_d: str = "none"
     # U-Net decoder dropout (the pix2pix noise source). The train step
     # threads a per-step dropout rng when this is on.
     use_dropout: bool = False
@@ -218,6 +226,14 @@ class ParallelConfig:
     # block convs); "conv" = save conv outputs + norm stats, recompute only
     # elementwise chains (policy remat — no extra MXU work).
     remat: Union[bool, str] = False
+    # Latency-hiding GPipe schedule (parallel/pp.py gpipe_trunk overlap=):
+    # the stage→stage ppermute is issued on the PREVIOUS tick's output, so
+    # the transfer runs concurrently with this tick's block compute
+    # (double-buffered hand-off). Costs S-1 extra fill/drain ticks —
+    # pays when the ICI hop is a meaningful fraction of stage compute
+    # (transfer_time/stage_time > (S-1)/(M+S-1)); off by default pending
+    # an on-chip win at the driver's mesh shapes.
+    pp_overlap: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,9 +27,9 @@ from typing import Optional, Union
 import jax.numpy as jnp
 from flax import linen as nn
 
-from p2p_tpu.ops.activations import PReLU, leaky_relu_y, relu_y, tanh_y
+from p2p_tpu.ops.activations import PReLU, leaky_relu_y, tanh_y
 from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
-from p2p_tpu.ops.norm import make_norm
+from p2p_tpu.ops.norm import make_norm, make_norm_act
 from p2p_tpu.ops.pixel_shuffle import pixel_unshuffle
 from p2p_tpu.ops.conv import upsample_nearest
 
@@ -50,16 +50,17 @@ class ResidualBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        # norm_act: the conv epilogue (norm → [+residual] → relu) behind
+        # ONE seam so the instance-norm HD configs fuse the whole chain
+        # into the Pallas normalize pass (ops/pallas/norm_act.py)
+        na = make_norm_act(self.norm, train=train, dtype=self.dtype)
         ub = self.legacy_layout or self.norm == "none"
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       use_bias=ub, dtype=self.dtype)(x)
-        y = mk()(y)
-        y = relu_y(y)
+        y = na(y, act="relu")
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       use_bias=ub, dtype=self.dtype)(y)
-        y = mk()(y)
-        return relu_y(y + x)
+        return na(y, act="relu", residual=x)
 
 
 class ExpandNetwork(nn.Module):

@@ -32,6 +32,7 @@ from flax import linen as nn
 
 from p2p_tpu.ops.activations import leaky_relu_y
 from p2p_tpu.ops.conv import KN2RowConv, normal_init, save_conv_out
+from p2p_tpu.ops.norm import make_norm_act
 from p2p_tpu.ops.spectral_norm import SpectralConv
 
 
@@ -154,12 +155,29 @@ class NLayerDiscriminator(nn.Module):
     # normalized w/σ is quantized (SpectralConv.int8).
     int8: bool = False
     int8_delayed: bool = False
+    # Normalization on the inner (stage 1..n_layers) convs — the pix2pixHD
+    # paper's D carries InstanceNorm there; this repo's reference lineage
+    # (networks.py:716) has none, so "none" is the parity default.
+    # "instance"/"pallas_instance" norms are affine-free → the param tree
+    # is IDENTICAL either way (checkpoints interchange); with
+    # "pallas_instance" the whole conv epilogue (norm + LeakyReLU) runs as
+    # ONE fused Pallas pass (ops/pallas/norm_act.py) — the D-side leaky
+    # variant of the generator's fused chains.
+    norm: str = "none"
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x) -> List[jax.Array]:
+        if self.norm not in ("none", "instance", "pallas_instance"):
+            # the train step threads no batch_stats for D — stat-free
+            # (per-forward) norms only
+            raise ValueError(
+                f"discriminator norm must be none/instance/pallas_instance "
+                f"(stateless), got {self.norm!r}")
         feats = []
         nf = self.ndf
+        na = (make_norm_act(self.norm, dtype=self.dtype)
+              if self.norm != "none" else None)
         y = _PlainConv(nf, stride=2, dtype=self.dtype)(x)
         y = leaky_relu_y(y, 0.2)
         feats.append(y)
@@ -175,6 +193,8 @@ class NLayerDiscriminator(nn.Module):
                 y = _PlainConv(features, stride=stride, int8=self.int8,
                                int8_delayed=self.int8_delayed,
                                dtype=self.dtype)(y)
+            if na is not None:
+                return na(y, act="leaky", slope=0.2)
             return leaky_relu_y(y, 0.2)
 
         for _ in range(1, self.n_layers):
@@ -205,6 +225,7 @@ class MultiscaleDiscriminator(nn.Module):
     get_interm_feat: bool = True
     int8: bool = False
     int8_delayed: bool = False
+    norm: str = "none"
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -222,6 +243,7 @@ class MultiscaleDiscriminator(nn.Module):
                 get_interm_feat=self.get_interm_feat,
                 int8=self.int8,
                 int8_delayed=self.int8_delayed,
+                norm=self.norm,
                 dtype=self.dtype,
                 name=f"scale{self.num_D - 1 - i}",
             )

@@ -29,11 +29,8 @@ from flax import linen as nn
 from p2p_tpu.models.patchgan import avg_pool_downsample
 from p2p_tpu.models.resnet_gen import ResnetBlock, ResnetGenerator
 from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
-from p2p_tpu.ops.norm import make_norm
-from p2p_tpu.ops.activations import (
-    relu_y,
-    tanh_y,
-)
+from p2p_tpu.ops.norm import make_norm_act
+from p2p_tpu.ops.activations import tanh_y
 
 
 def GlobalGenerator(
@@ -79,7 +76,9 @@ class Pix2PixHDGenerator(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        # fused conv epilogues for norm='pallas_instance' (ops/norm.py
+        # make_norm_act — the same seam the ResNet family uses)
+        na = make_norm_act(self.norm, train=train, dtype=self.dtype)
         ub = self.legacy_layout or self.norm == "none"
         ngf_local = self.ngf // 2
 
@@ -94,10 +93,10 @@ class Pix2PixHDGenerator(nn.Module):
         # G2 front end on the full-res input, down to half res
         y = ConvLayer(ngf_local, kernel_size=7, use_bias=ub,
                       dtype=self.dtype)(x)
-        y = relu_y(mk()(y))
+        y = na(y, act="relu")
         y = ConvLayer(self.ngf, kernel_size=3, stride=2, use_bias=ub,
                       dtype=self.dtype)(y)
-        y = relu_y(mk()(y))
+        y = na(y, act="relu")
 
         # fuse + local trunk
         y = y + g1_feats
@@ -110,6 +109,6 @@ class Pix2PixHDGenerator(nn.Module):
 
         y = UpsampleConvLayer(ngf_local, kernel_size=3, upsample=2,
                               use_bias=ub, dtype=self.dtype)(y)
-        y = relu_y(mk()(y))
+        y = na(y, act="relu")
         y = ConvLayer(self.out_channels, kernel_size=7, dtype=self.dtype)(y)
         return tanh_y(y)

@@ -100,6 +100,7 @@ def define_D(cfg: ModelConfig, dtype=None) -> nn.Module:
         get_interm_feat=cfg.get_interm_feat,
         int8=cfg.int8,
         int8_delayed=cfg.int8_delayed,
+        norm=cfg.norm_d,
         dtype=dtype,
     )
 

@@ -20,11 +20,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from p2p_tpu.ops.conv import ConvLayer, UpsampleConvLayer, remat_wrap
-from p2p_tpu.ops.norm import make_norm
-from p2p_tpu.ops.activations import (
-    relu_y,
-    tanh_y,
-)
+from p2p_tpu.ops.norm import make_norm_act
+from p2p_tpu.ops.activations import tanh_y
 
 
 class ResnetBlock(nn.Module):
@@ -44,15 +41,17 @@ class ResnetBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        # norm_act: the conv epilogue (norm → [+residual] → act) behind ONE
+        # seam so norm='pallas_instance' fuses the whole chain into the
+        # Pallas normalize pass (ops/pallas/norm_act.py)
+        na = make_norm_act(self.norm, train=train, dtype=self.dtype)
         ub = self.legacy_layout or self.norm == "none"
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       use_bias=ub, dtype=self.dtype)(x)
-        y = relu_y(mk()(y))
+        y = na(y, act="relu")
         y = ConvLayer(self.features, kernel_size=3, int8=self.int8, int8_delayed=self.int8_delayed,
                       use_bias=ub, dtype=self.dtype)(y)
-        y = mk()(y)
-        return x + y
+        return na(y, residual=x)
 
 
 class ResnetGenerator(nn.Module):
@@ -78,19 +77,19 @@ class ResnetGenerator(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True, trunk_fn=None):
-        mk = make_norm(self.norm, train=train, dtype=self.dtype)
+        na = make_norm_act(self.norm, train=train, dtype=self.dtype)
         cap = self.max_features or (1 << 30)
         # every conv below except the head is norm-followed → dead bias
         ub = self.legacy_layout or self.norm == "none"
 
         y = ConvLayer(self.ngf, kernel_size=7, use_bias=ub,
                       dtype=self.dtype)(x)
-        y = relu_y(mk()(y))
+        y = na(y, act="relu")
         for i in range(self.n_downsampling):
             f = min(self.ngf * (2 ** (i + 1)), cap)
             y = ConvLayer(f, kernel_size=3, stride=2, use_bias=ub,
                           dtype=self.dtype)(y)
-            y = relu_y(mk()(y))
+            y = na(y, act="relu")
 
         if trunk_fn is not None:
             # externally-scheduled trunk (the GPipe path, parallel/pp.py):
@@ -112,7 +111,7 @@ class ResnetGenerator(nn.Module):
             f = min(self.ngf * (2 ** i), cap)
             y = UpsampleConvLayer(f, kernel_size=3, upsample=2,
                                   use_bias=ub, dtype=self.dtype)(y)
-            y = relu_y(mk()(y))
+            y = na(y, act="relu")
         if self.return_features:
             return y
         y = ConvLayer(self.out_channels, kernel_size=7, dtype=self.dtype)(y)

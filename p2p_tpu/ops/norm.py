@@ -221,6 +221,45 @@ class InstanceNorm(nn.Module):
         return y.astype(self.dtype or orig_dtype)
 
 
+def make_norm_act(kind: str, *, train: bool = True,
+                  axis_name: Optional[str] = None, dtype=None):
+    """Factory for the post-conv epilogue ``act(norm(y) [+ residual])`` —
+    the ONE seam the generator/discriminator blocks call so the
+    ``pallas_instance`` kind can fuse the whole chain into the Pallas
+    normalize pass (ops/pallas/norm_act.py) while every other kind keeps
+    today's exact op order (norm module → residual add → output-masked
+    activation). Returns ``apply(y, act="none", slope=0.2, residual=None)``;
+    call inside ``@nn.compact`` (the non-fused kinds instantiate their norm
+    module per call, so flax auto-naming — and therefore param/stat trees —
+    is identical to the unfused ``make_norm`` layout)."""
+    if kind == "pallas_instance":
+        from p2p_tpu.ops.pallas.instance_norm import pallas_instance_norm_act
+
+        def apply_fused(y, act: str = "none", slope: float = 0.2,
+                        residual=None):
+            out = pallas_instance_norm_act(y, residual=residual, act=act,
+                                           slope=slope)
+            return out.astype(dtype or y.dtype)
+
+        return apply_fused
+
+    mk = make_norm(kind, train=train, axis_name=axis_name, dtype=dtype)
+
+    def apply_ref(y, act: str = "none", slope: float = 0.2, residual=None):
+        from p2p_tpu.ops.activations import leaky_relu_y, relu_y
+
+        z = mk()(y)
+        if residual is not None:
+            z = z + residual
+        if act == "relu":
+            return relu_y(z)
+        if act == "leaky":
+            return leaky_relu_y(z, slope)
+        return z
+
+    return apply_ref
+
+
 def make_norm(kind: str, *, train: bool = True, axis_name: Optional[str] = None,
               dtype=None):
     """Factory mapping config ``norm`` strings to layer constructors.

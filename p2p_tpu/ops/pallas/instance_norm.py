@@ -32,6 +32,29 @@ def _xla_instance_norm(x, scale, bias, eps):
     return y.astype(x.dtype)
 
 
+def _xla_instance_norm_act(x, scale, bias, residual, act, slope, eps):
+    """The lax reference for the fused epilogue — the CPU/tier-1 fallback
+    of :func:`pallas_instance_norm_act` (same op order as the kernel:
+    norm → affine → residual add → activation, all in f32)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+    var = jnp.var(x32, axis=(1, 2), keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale + bias
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    if act == "relu":
+        from p2p_tpu.ops.activations import relu_y
+
+        y = relu_y(y)
+    elif act == "leaky":
+        from p2p_tpu.ops.activations import leaky_relu_y
+
+        y = leaky_relu_y(y, slope)
+    return y.astype(x.dtype)
+
+
 def sharded_pallas_instance_norm(
     x: jax.Array,
     scale: Optional[jax.Array],
@@ -121,6 +144,79 @@ def pallas_instance_norm(
     from p2p_tpu.ops.pallas.instance_norm_kernel import instance_norm_fused
 
     return instance_norm_fused(x, scale, bias, eps, interpret=interp)
+
+
+def sharded_pallas_instance_norm_act(
+    x, scale, bias, residual, act, slope, eps, mesh, interpret=False):
+    """The fused norm+act(+residual) kernel inside a shard_map region —
+    same GSPMD custom-call rationale as :func:`sharded_pallas_instance_norm`
+    (the residual shards like ``x``; only stat tiles cross the ICI)."""
+    from jax.sharding import PartitionSpec as P
+
+    from p2p_tpu.core.mesh import (
+        DATA_AXIS,
+        SPATIAL_AXIS,
+        shard_map_compat as shard_map,
+    )
+    from p2p_tpu.ops.pallas.norm_act import instance_norm_act_fused_sharded
+
+    x_spec = P(DATA_AXIS, SPATIAL_AXIS, None, None)
+    affine = scale is not None
+    has_res = residual is not None
+    in_specs = [x_spec] + ([P(), P()] if affine else []) + (
+        [x_spec] if has_res else [])
+    args = (x,) + ((scale, bias) if affine else ()) + (
+        (residual,) if has_res else ())
+
+    def body(*a):
+        it = iter(a)
+        xl = next(it)
+        s = next(it) if affine else None
+        b = next(it) if affine else None
+        r = next(it) if has_res else None
+        return instance_norm_act_fused_sharded(
+            xl, s, b, r, act=act, slope=slope, eps=eps,
+            axis_name=SPATIAL_AXIS, interpret=interpret)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=x_spec,
+        check_vma=False,  # pallas out_shapes carry no vma info
+    )
+    return fn(*args)
+
+
+def pallas_instance_norm_act(
+    x: jax.Array,
+    scale: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    residual: Optional[jax.Array] = None,
+    act: str = "none",
+    slope: float = 0.2,
+    eps: float = 1e-5,
+    force_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """InstanceNorm with the whole post-conv epilogue fused:
+    ``act(norm(x)·γ+β [+ residual])`` — the dispatch seam for the fused
+    norm+activation chains (docs/PERFORMANCE.md). TPU backends run the
+    Pallas kernel (ops/pallas/norm_act.py); inside a spatial-sharded step
+    the shard_map variant keeps the custom call on local shards; elsewhere
+    the lax reference runs (so CPU tier-1 exercises the same call sites)."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    force_pallas = force_pallas or os.environ.get(
+        "P2P_TPU_FORCE_PALLAS") == "1"
+    if not (on_tpu or force_pallas):
+        return _xla_instance_norm_act(x, scale, bias, residual, act, slope,
+                                      eps)
+    interp = interpret or not on_tpu
+    mesh = _sharding_mesh_for(x)
+    if mesh is not None:
+        return sharded_pallas_instance_norm_act(
+            x, scale, bias, residual, act, slope, eps, mesh, interp)
+    from p2p_tpu.ops.pallas.norm_act import instance_norm_act_fused
+
+    return instance_norm_act_fused(x, scale, bias, residual, act=act,
+                                   slope=slope, eps=eps, interpret=interp)
 
 
 class PallasInstanceNorm(nn.Module):

@@ -102,13 +102,26 @@ def place_trunk_pp(stacked: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
 
 
 def gpipe_trunk(block_apply: BlockApply, stacked: Dict[str, Any],
-                y_mb: jax.Array, mesh: Mesh):
+                y_mb: jax.Array, mesh: Mesh, overlap: bool = False):
     """Run the stacked trunk over ``y_mb`` [M, mb, H, W, C] with the GPipe
     fill/drain schedule on the mesh's ``pipe`` axis.
 
     ``block_apply(block_vars, y) -> y`` applies ONE residual block given its
     (unstacked) variable subtree. Output has the same shape/sharding as
     ``y_mb`` (mb stays on ``data``); result is replicated over ``pipe``.
+
+    ``overlap=True`` switches to the LATENCY-HIDING schedule: the hand-off
+    is double-buffered — each tick issues the ``ppermute`` on the PREVIOUS
+    tick's output (a scan-carry value, structurally independent of this
+    tick's block compute), so the ICI transfer runs concurrently with the
+    stage compute instead of serializing after it. The stage→stage hop then
+    takes two ticks (stage ``s`` holds microbatch ``t − 2s`` at tick ``t``)
+    and the schedule runs ``M + 2(S−1)`` ticks vs the serial ``M + S − 1``:
+    the doubled fill/drain bubble buys ticks of ``max(compute, transfer)``
+    instead of ``compute + transfer`` — a win when ``transfer/compute >
+    (S−1)/(M+S−1)``. Numerics are IDENTICAL (same blocks on the same
+    microbatches; pinned bitwise in tests/test_pp.py), and the
+    issued-from-carry property is pinned structurally on the jaxpr.
 
     When ``stacked`` carries a ``'quant'`` collection (the delayed-int8
     trunk, ops/int8.py), ``block_apply`` must instead return ``(y, quant
@@ -122,7 +135,9 @@ def gpipe_trunk(block_apply: BlockApply, stacked: Dict[str, Any],
     """
     n_stages = mesh.shape[PIPE_AXIS]
     n_micro = int(y_mb.shape[0])
-    ticks = n_micro + n_stages - 1
+    # per-stage microbatch lag: 1 tick/hop serial, 2 ticks/hop overlapped
+    lag = 2 if overlap else 1
+    ticks = n_micro + lag * (n_stages - 1)
     act_spec = P(None, DATA_AXIS, *([None] * (y_mb.ndim - 2)))
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     has_quant = "quant" in stacked
@@ -142,31 +157,56 @@ def gpipe_trunk(block_apply: BlockApply, stacked: Dict[str, Any],
             y, _ = jax.lax.scan(body, y, local)
             return y, {}
 
-        def tick(carry, t):
-            act, out, qacc = carry
+        def retire(out, y_out, t):
+            # last stage retires microbatch t-lag·(S-1) into its slot
+            o_idx = jnp.clip(t - lag * (n_stages - 1), 0, n_micro - 1)
+            prev = jax.lax.dynamic_index_in_dim(out, o_idx, 0,
+                                                keepdims=False)
+            write = jnp.logical_and(t >= lag * (n_stages - 1),
+                                    idx == n_stages - 1)
+            return jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(write, y_out, prev), o_idx, 0)
+
+        def acc_quant(qacc, qp, t):
+            # amax bookkeeping is carried state, never a loss input —
+            # cut it out of the autodiff graph (pmax/psum-max below
+            # have no differentiation rule, and none is wanted)
+            qp = jax.tree.map(jax.lax.stop_gradient, qp)
+            # stage `idx` holds microbatch t-lag·idx at tick t — bubble
+            # ticks (fill zeros, drain re-feeds) must not touch amax
+            valid = jnp.logical_and(t >= lag * idx,
+                                    t - lag * idx <= n_micro - 1)
+            return jax.tree.map(
+                lambda a, p: jnp.where(valid, jnp.maximum(a, p), a),
+                qacc, qp)
+
+        def feed_at(t):
             # stage 0 injects microbatch t (clamped re-feeds during drain
             # are bubble ticks whose output is never written)
-            feed = jax.lax.dynamic_index_in_dim(
+            return jax.lax.dynamic_index_in_dim(
                 xmb, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
-            y_out, qp = stage(jnp.where(idx == 0, feed, act))
+
+        def tick(carry, t):
+            act, out, qacc = carry
+            y_out, qp = stage(jnp.where(idx == 0, feed_at(t), act))
             if has_quant:
-                # amax bookkeeping is carried state, never a loss input —
-                # cut it out of the autodiff graph (pmax/psum-max below
-                # have no differentiation rule, and none is wanted)
-                qp = jax.tree.map(jax.lax.stop_gradient, qp)
-                # stage `idx` holds microbatch t-idx at tick t — bubble
-                # ticks (fill zeros, drain re-feeds) must not touch amax
-                valid = jnp.logical_and(t >= idx, t - idx <= n_micro - 1)
-                qacc = jax.tree.map(
-                    lambda a, p: jnp.where(valid, jnp.maximum(a, p), a),
-                    qacc, qp)
-            # last stage retires microbatch t-(S-1) into its output slot
-            o_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-            prev = jax.lax.dynamic_index_in_dim(out, o_idx, 0, keepdims=False)
-            write = jnp.logical_and(t >= n_stages - 1, idx == n_stages - 1)
-            out = jax.lax.dynamic_update_index_in_dim(
-                out, jnp.where(write, y_out, prev), o_idx, 0)
+                qacc = acc_quant(qacc, qp, t)
+            out = retire(out, y_out, t)
             return (jax.lax.ppermute(y_out, PIPE_AXIS, perm), out, qacc), None
+
+        def tick_overlap(carry, t):
+            recv, y_prev, out, qacc = carry
+            # double-buffered hand-off: transfer LAST tick's output now —
+            # ``y_prev`` is a scan carry, so this collective has no data
+            # dependence on this tick's stage compute and the scheduler is
+            # free to run the ICI hop under it (the latency-hiding point;
+            # pinned structurally by tests/test_pp.py)
+            send = jax.lax.ppermute(y_prev, PIPE_AXIS, perm)
+            y_out, qp = stage(jnp.where(idx == 0, feed_at(t), recv))
+            if has_quant:
+                qacc = acc_quant(qacc, qp, t)
+            out = retire(out, y_out, t)
+            return (send, y_out, out, qacc), None
 
         # carries are stage-varying (idx enters tick) — pcast the replicated
         # zeros to the varying type shard_map's vma tracking expects
@@ -178,8 +218,14 @@ def gpipe_trunk(block_apply: BlockApply, stacked: Dict[str, Any],
             lambda a: pcast_varying(jnp.zeros_like(a),
                                     (DATA_AXIS, PIPE_AXIS)),
             local.get("quant", {}))
-        (act, out, qacc), _ = jax.lax.scan(
-            tick, (zero, out0, q0), jnp.arange(ticks))
+        if overlap:
+            zero2 = pcast_varying(
+                jnp.zeros(xmb.shape[1:], xmb.dtype), (DATA_AXIS, PIPE_AXIS))
+            (_, _, out, qacc), _ = jax.lax.scan(
+                tick_overlap, (zero, zero2, out0, q0), jnp.arange(ticks))
+        else:
+            (_, out, qacc), _ = jax.lax.scan(
+                tick, (zero, out0, q0), jnp.arange(ticks))
         # non-last stages accumulated zeros; the masked psum replicates the
         # last stage's outputs to every pipe shard
         y_full = jax.lax.psum(
@@ -306,7 +352,8 @@ def _trunk_block_apply(model_cfg, dtype=None) -> BlockApply:
 def pp_generator_forward(model_cfg, variables: Dict[str, Any],
                          x_mb: jax.Array, mesh: Mesh,
                          stacked: Optional[Dict[str, Any]] = None,
-                         dtype=None, with_quant: bool = False):
+                         dtype=None, with_quant: bool = False,
+                         overlap: bool = False):
     """Full pipelined generator forward (expand / resnet trunk families).
 
     ``x_mb``: [M, mb, H, W, 3] microbatched input (mb sharded over ``data``).
@@ -337,7 +384,8 @@ def pp_generator_forward(model_cfg, variables: Dict[str, Any],
     def trunk_fn(y):
         nonlocal q_new
         r = gpipe_trunk(block_apply, stacked,
-                        mb_major_unflatten(y, n_micro), mesh)
+                        mb_major_unflatten(y, n_micro), mesh,
+                        overlap=overlap)
         if "quant" in stacked:
             y_mb, q_new = r
         else:
@@ -357,7 +405,7 @@ def pp_generator_forward(model_cfg, variables: Dict[str, Any],
 def pp_expand_forward(model_cfg, variables: Dict[str, Any], x_mb: jax.Array,
                       mesh: Mesh,
                       stacked: Optional[Dict[str, Any]] = None,
-                      dtype=None) -> jax.Array:
+                      dtype=None, overlap: bool = False) -> jax.Array:
     """Pipelined flagship (ExpandNetwork) forward — the expand-only entry
     point kept for compatibility; :func:`pp_generator_forward` is the
     general form (and the one the PP train step uses)."""
@@ -366,7 +414,8 @@ def pp_expand_forward(model_cfg, variables: Dict[str, Any], x_mb: jax.Array,
             "pp_expand_forward pipelines the ExpandNetwork trunk; use "
             "pp_generator_forward for the ResNet family")
     return pp_generator_forward(model_cfg, variables, x_mb, mesh,
-                                stacked=stacked, dtype=dtype)
+                                stacked=stacked, dtype=dtype,
+                                overlap=overlap)
 
 
 # ---------------------------------------------------------------------------

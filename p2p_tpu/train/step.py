@@ -645,6 +645,9 @@ def build_pp_train_step(
     d_colls = ("spectral", "quant") if use_quant_d else ("spectral",)
     g_loss_fn = make_g_loss_fn(cfg, vgg_params, steps_per_epoch)
     health_guard = cfg.health.enabled
+    # latency-hiding schedule (parallel/pp.py gpipe_trunk overlap=): the
+    # stage hand-off ppermute is double-buffered against stage compute
+    pp_overlap = cfg.parallel.pp_overlap
 
     def d_fwd(params, dvars, x):
         out, mut = d.apply(
@@ -696,7 +699,7 @@ def build_pp_train_step(
                 stk["quant"] = quant_stack
             out_mb, qnew = pp_generator_forward(
                 cfg.model, variables, unflat(x), mesh, stacked=stk,
-                dtype=train_dtype, with_quant=True)
+                dtype=train_dtype, with_quant=True, overlap=pp_overlap)
             return flat(out_mb), qnew
 
         # ONE pipelined generator forward via explicit jax.vjp (the same

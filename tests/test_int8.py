@@ -299,6 +299,123 @@ def test_delayed_step_trains_and_threads_quant_state():
     assert np.isfinite(float(np.mean(np.asarray(em["psnr"]))))
 
 
+# --------------------------------------- int8 multiscale discriminator
+def _multi_d_cfg(int8=True):
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+
+    cfg = get_preset("facades")
+    return cfg.replace(
+        model=dataclasses.replace(
+            cfg.model, ngf=8, ndf=8, num_D=3, n_layers_D=3,
+            use_spectral_norm=True, use_dropout=False,
+            int8=int8, int8_delayed=int8),
+        data=dataclasses.replace(cfg.data, batch_size=2, image_size=32),
+        train=dataclasses.replace(cfg.train, mixed_precision=False),
+    )
+
+
+def test_int8_multiscale_d_threads_quant_through_all_scales():
+    """ISSUE 6 lever 1: the delayed-int8 path covers ALL THREE
+    NLayerDiscriminators of the multiscale D — every scale's spectral-norm
+    inner convs carry an amax in the 'quant' collection, and one training
+    step moves scales on every scale (not just scale0)."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = _multi_d_cfg()
+    b = {k: jnp.asarray(v) for k, v in synthetic_batch(2, 32).items()}
+    state = create_train_state(cfg, jax.random.key(0), b, 1)
+    for s in range(3):
+        assert f"scale{s}" in state.quant_d, sorted(state.quant_d)
+        # n_layers=3 → 3 spectral inner convs per scale, each with amax_x
+        leaves = jax.tree_util.tree_leaves(state.quant_d[f"scale{s}"])
+        assert len(leaves) == 3, (s, len(leaves))
+    before = {s: [float(a) for a in
+                  jax.tree_util.tree_leaves(state.quant_d[f"scale{s}"])]
+              for s in range(3)}
+    step = build_train_step(cfg, None, 1, None)
+    state, m = step(state, b)
+    state, m = step(state, {k: 2.5 * v for k, v in b.items()})
+    assert np.isfinite(float(m["loss_d"]))
+    for s in range(3):
+        after = [float(a) for a in
+                 jax.tree_util.tree_leaves(state.quant_d[f"scale{s}"])]
+        assert after != before[s], f"scale{s} amax never moved"
+
+
+def test_int8_multiscale_d_frozen_scale_eval_bitwise():
+    """The frozen-scale eval pin, D-side twin of the G-trunk/serving ones:
+    with the 'quant' collection read-only (eval), the multiscale D forward
+    is a pure function of its stored scales — two applies are BITWISE
+    equal, and equal to the primal of the mutable (training) apply that
+    proposed updates from the same scales."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.models.registry import define_D
+
+    cfg = _multi_d_cfg()
+    d = define_D(cfg.model)
+    rng = np.random.default_rng(3)
+    pair = jnp.asarray(rng.uniform(-1, 1, (2, 32, 32, 6)), jnp.float32)
+    v = d.init(jax.random.key(1), pair)
+    assert "quant" in v and "spectral" in v
+    dvars = {"params": v["params"], "spectral": v["spectral"],
+             "quant": v["quant"]}
+
+    train_out, mut = d.apply(dvars, pair, mutable=["spectral", "quant"])
+    eval1 = d.apply(dvars, pair)
+    eval2 = d.apply(dvars, pair)
+    for a, b in zip(jax.tree_util.tree_leaves(eval1),
+                    jax.tree_util.tree_leaves(eval2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(eval1),
+                    jax.tree_util.tree_leaves(train_out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the training apply did propose scale updates (it is the one mutating)
+    assert jax.tree_util.tree_leaves(mut["quant"])
+
+
+@pytest.mark.slow
+def test_int8_multiscale_d_lsgan_stability_band():
+    """The LSGAN-stability parity band, D-side twin of the G-trunk one:
+    training with the fully-quantized multiscale D tracks the f32-D run —
+    same finite trajectories, D loss within a band of the float oracle
+    over the run (quantization noise must not change the game's dynamics
+    at this horizon)."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    def run(int8):
+        cfg = _multi_d_cfg(int8=int8)
+        b = {k: jnp.asarray(v) for k, v in synthetic_batch(2, 32).items()}
+        state = create_train_state(cfg, jax.random.key(0), b, 1)
+        step = build_train_step(cfg, None, 1, None)
+        losses = []
+        for i in range(8):
+            bi = {k: jnp.roll(v, i, axis=0) for k, v in b.items()}
+            state, m = step(state, bi)
+            losses.append({k: float(m[k]) for k in ("loss_d", "loss_g")})
+        return losses
+
+    qs, fs = run(True), run(False)
+    for traj in (qs, fs):
+        assert all(np.isfinite(list(r.values())).all() for r in traj), traj
+    # parity band over the settled half of the run: mean |Δloss_d| within
+    # 35% of the float level (int8 D is a different-but-close game)
+    tail_q = np.mean([r["loss_d"] for r in qs[4:]])
+    tail_f = np.mean([r["loss_d"] for r in fs[4:]])
+    assert abs(tail_q - tail_f) <= 0.35 * max(abs(tail_f), 0.05), (
+        tail_q, tail_f)
+
+
 # ------------------------------------------- tiny-spatial wgrad guard
 TINY_WGRAD_SNIPPET = """
 import os, jax, jax.numpy as jnp, numpy as np

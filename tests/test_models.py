@@ -453,3 +453,52 @@ def test_split_stem_pair_path_equals_concat():
         np.asarray(jax.grad(loss_pair)(b)),
         rtol=2e-5, atol=2e-5,
     )
+
+
+def test_discriminator_norm_d_variants():
+    """ModelConfig.norm_d (the pix2pixHD-paper D layout): instance /
+    pallas_instance norms on the inner convs are affine-free, so the
+    param/spectral trees are IDENTICAL to norm='none' (checkpoints
+    interchange); the two instance kinds agree numerically (the fused
+    Pallas epilogue == module chain); stateful norms are rejected."""
+    x = jnp.asarray(
+        np.random.default_rng(5).uniform(-1, 1, (2, 32, 32, 6)), jnp.float32)
+    plain = MultiscaleDiscriminator(ndf=8, n_layers=3, num_D=2)
+    inst = MultiscaleDiscriminator(ndf=8, n_layers=3, num_D=2,
+                                   norm="instance")
+    fused = MultiscaleDiscriminator(ndf=8, n_layers=3, num_D=2,
+                                    norm="pallas_instance")
+    v = plain.init(jax.random.key(0), x)
+    v_i = inst.init(jax.random.key(0), x)
+    assert (jax.tree_util.tree_structure(v) ==
+            jax.tree_util.tree_structure(v_i))
+
+    out_i = inst.apply(v, x)
+    out_f = fused.apply(v, x)
+    for fi, ff in zip(out_i, out_f):
+        for a, b in zip(fi, ff):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)
+    # normed D differs from the norm-free one (the option is live)
+    out_p = plain.apply(v, x)
+    assert not np.allclose(np.asarray(out_p[0][-1]),
+                           np.asarray(out_i[0][-1]))
+
+    with pytest.raises(ValueError, match="stateless"):
+        NLayerDiscriminator(ndf=8, norm="batch").init(jax.random.key(0), x)
+
+
+def test_discriminator_norm_d_composes_with_int8():
+    """norm_d composes with the delayed-int8 inner convs: the quant
+    collection still threads and the forward stays finite/close to the
+    un-normed int8 D's structure (one mutable apply)."""
+    d = MultiscaleDiscriminator(ndf=8, n_layers=2, num_D=2, int8=True,
+                                int8_delayed=True, norm="pallas_instance")
+    x = jnp.asarray(
+        np.random.default_rng(6).uniform(-1, 1, (2, 32, 32, 6)), jnp.float32)
+    v = d.init(jax.random.key(1), x)
+    assert "quant" in v
+    out, mut = d.apply(v, x, mutable=["spectral", "quant"])
+    assert jax.tree_util.tree_leaves(mut["quant"])
+    for leaf in jax.tree_util.tree_leaves(out):
+        assert np.isfinite(np.asarray(leaf)).all()

@@ -467,3 +467,38 @@ def test_trainer_sentinel_handler_routes_to_run_registry(tmp_path):
     assert tr._sentinel_handler is None
     assert all(getattr(h, "__name__", "") != "_handler"
                for h in taps._handlers)
+
+
+def test_retrace_watchdog_persistent_cache_hit_on_identical_compile(
+        tmp_path):
+    """ISSUE 6 satellite: the persistent-XLA-cache hit/miss counters on
+    RetraceWatchdog, asserted end-to-end — a second identical backend
+    compile (in-memory executable cache dropped, so the request really
+    reaches the backend) is served from the on-disk cache and lands in
+    ``cache_hits`` AND the ``persistent_cache_hits`` registry counter,
+    with the first compile counted as a miss."""
+    from p2p_tpu.core import cache as cache_mod
+    from p2p_tpu.core.cache import enable_compilation_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_enabled = cache_mod._enabled_dir
+    reg = obs.MetricsRegistry()
+    w = obs.RetraceWatchdog(registry=reg)
+    try:
+        enable_compilation_cache(str(tmp_path / "xla_cache"))
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        f(jnp.ones((3,)))                     # first compile: cache MISS
+        assert w.cache_misses >= 1
+        assert reg.counter("persistent_cache_misses").value >= 1
+        assert os.listdir(str(tmp_path / "xla_cache")), \
+            "first compile wrote no cache entry"
+
+        hits_before = w.cache_hits
+        jax.clear_caches()                    # drop in-memory executables
+        f(jnp.ones((3,)))                     # identical compile: HIT
+        assert w.cache_hits > hits_before
+        assert reg.counter("persistent_cache_hits").value >= 1
+    finally:
+        w.close()
+        cache_mod._enabled_dir = prev_enabled
+        jax.config.update("jax_compilation_cache_dir", prev_dir)

@@ -182,6 +182,162 @@ def test_pp_no_full_activation_allgather(devices8):
             assert numel < full, (numel, ln)
 
 
+# ---------------------------------------------- latency-hiding schedule
+
+
+def _sub_jaxprs(params):
+    for p in params.values():
+        vals = p if isinstance(p, (list, tuple)) else [p]
+        for q in vals:
+            if hasattr(q, "eqns"):
+                yield q
+            elif hasattr(q, "jaxpr") and hasattr(q.jaxpr, "eqns"):
+                yield q.jaxpr
+
+
+def _scan_ppermute_from_carry_flags(jaxpr, out):
+    """For every ppermute directly inside a lax.scan body: True iff its
+    operand is a scan CARRY invar (i.e. the transfer consumes the previous
+    tick's value and has no data dependence on this tick's compute)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            body = eqn.params["jaxpr"].jaxpr
+            nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+            carry = set(map(id, body.invars[nc:nc + nk]))
+            for e2 in body.eqns:
+                if e2.primitive.name == "ppermute":
+                    out.append(id(e2.invars[0]) in carry)
+            _scan_ppermute_from_carry_flags(body, out)
+        else:
+            for sj in _sub_jaxprs(eqn.params):
+                _scan_ppermute_from_carry_flags(sj, out)
+
+
+def test_pp_overlap_forward_bitwise(devices8):
+    """The double-buffered schedule (overlap=True) == the serial schedule
+    == the per-microbatch unpipelined apply, BITWISE: the same blocks see
+    the same microbatches, only the hand-off timing changes."""
+    mcfg, g, v, x = _setup(norm="batch", n_blocks=6, batch=8)
+    mesh = make_mesh(MeshSpec(data=1, pipe=3), devices=devices8[:3])
+    x_mb = x.reshape(4, 2, 32, 32, 3)
+    out_o = jax.jit(lambda vr, xm: pp_expand_forward(
+        mcfg, vr, xm, mesh, overlap=True))(v, x_mb)
+    out_s = jax.jit(lambda vr, xm: pp_expand_forward(
+        mcfg, vr, xm, mesh))(v, x_mb)
+    ref = _ref_per_microbatch(g, v, x_mb)
+    assert np.array_equal(np.asarray(out_o), np.asarray(out_s))
+    assert np.array_equal(np.asarray(out_o), ref)
+
+
+def test_pp_overlap_schedule_issues_transfer_from_carry(devices8):
+    """The latency-hiding pin (ISSUE 6): in the overlapped schedule the
+    tick's ``ppermute`` consumes the PREVIOUS tick's output — a scan-carry
+    invar — so it is structurally independent of the tick's stage compute
+    and the TPU scheduler is free to overlap the ICI hop with it. The
+    serial schedule's ppermute consumes this tick's freshly-computed
+    ``y_out`` (NOT a carry), which is exactly the serialization the
+    overlap removes. Pinned on the jaxpr (the schedule structure XLA
+    receives); the compiled HLO must still carry the collective. Mirrors
+    the no-all-gather pin style: assert on the program, not on timing."""
+    mcfg, _, v, x = _setup(norm="batch", n_blocks=4)
+    mesh = make_mesh(MeshSpec(data=1, pipe=2), devices=devices8[:2])
+    x_mb = x.reshape(4, 2, 32, 32, 3)
+
+    flags = {}
+    for ov in (False, True):
+        jx = jax.make_jaxpr(lambda vr, xm: pp_expand_forward(
+            mcfg, vr, xm, mesh, overlap=ov))(v, x_mb)
+        found = []
+        _scan_ppermute_from_carry_flags(jx.jaxpr, found)
+        assert found, f"no ppermute found in the scan body (overlap={ov})"
+        flags[ov] = found
+    assert all(flags[True]), flags    # overlapped: issued from the carry
+    assert not any(flags[False]), flags  # serial: issued from this tick
+
+    # the lowered collective survives compilation (the schedule is not
+    # optimized into something else on the fake mesh)
+    hlo = jax.jit(lambda vr, xm: pp_expand_forward(
+        mcfg, vr, xm, mesh, overlap=True)).lower(
+            v, x_mb).compile().as_text()
+    assert "collective-permute" in hlo
+
+
+def test_pp_overlap_grads_and_quant_match_serial(devices8):
+    """Backward + delayed-int8 'quant' bookkeeping through the overlapped
+    schedule match the serial schedule bitwise (the lag-2 validity masks
+    must select exactly the same non-bubble ticks)."""
+    from p2p_tpu.parallel.pp import pp_generator_forward
+
+    mcfg, g, v, x = _setup(n_blocks=2, int8=True, int8_generator=True,
+                           int8_delayed=True)
+    mesh = make_mesh(MeshSpec(data=2, pipe=2), devices=devices8[:4])
+    x_mb = x.reshape(4, 2, 32, 32, 3)
+    st = stack_trunk(v, 2)
+
+    def run(ov):
+        return jax.jit(lambda vr, stk, xm: pp_generator_forward(
+            mcfg, vr, xm, mesh, stacked=stk, with_quant=True,
+            overlap=ov))(v, st, x_mb)
+
+    out_s, q_s = run(False)
+    out_o, q_o = run(True)
+    assert np.array_equal(np.asarray(out_s), np.asarray(out_o))
+    for a, b in zip(jax.tree.leaves(q_s), jax.tree.leaves(q_o)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # grads: serial vs overlapped on the plain (no-quant) trunk
+    mcfg2, _, v2, x2 = _setup(norm="batch", n_blocks=4)
+    x2_mb = x2.reshape(4, 2, 32, 32, 3)
+    mesh2 = make_mesh(MeshSpec(data=1, pipe=2), devices=devices8[:2])
+
+    def loss(ov):
+        return lambda vr, xm: jnp.sum(jnp.square(pp_expand_forward(
+            mcfg2, vr, xm, mesh2, overlap=ov)))
+
+    g_s = jax.jit(jax.grad(loss(False)))(v2, x2_mb)["params"]
+    g_o = jax.jit(jax.grad(loss(True)))(v2, x2_mb)["params"]
+    for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_o)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_pp_overlap_full_gan_step_matches_unpipelined(devices8):
+    """build_pp_train_step with parallel.pp_overlap=True — the complete
+    alternating G/D/C update on the latency-hiding schedule — matches the
+    unpipelined oracle within the same bound as the serial PP step."""
+    import dataclasses as dc
+
+    from p2p_tpu.parallel.dp import replicate_state, shard_batch
+    from p2p_tpu.parallel.pp import pp_split_state
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_pp_train_step, build_train_step
+
+    cfg = _pp_gan_cfg()
+    cfg = cfg.replace(parallel=dc.replace(cfg.parallel, pp_overlap=True))
+    mesh = make_mesh(MeshSpec(data=2, pipe=2), devices=devices8[:4])
+    rng = np.random.default_rng(1)
+    batch = {k: jnp.asarray(rng.uniform(-1, 1, (4, 32, 32, 3)), jnp.float32)
+             for k in ("input", "target")}
+    state = create_train_state(cfg, jax.random.key(0), batch)
+
+    ref_state, ref_metrics = build_train_step(cfg)(
+        jax.tree_util.tree_map(jnp.copy, state), dict(batch))
+
+    pp_state = pp_split_state(replicate_state(state, mesh), cfg, mesh)
+    pp_step = build_pp_train_step(cfg, mesh, n_micro=2)
+    pp_state, pp_metrics = pp_step(pp_state, shard_batch(batch, mesh))
+
+    for k in ref_metrics:
+        np.testing.assert_allclose(
+            float(ref_metrics[k]), float(pp_metrics[k]),
+            rtol=2e-4, atol=2e-4, err_msg=k)
+    ref_stack = stack_trunk({"params": ref_state.params_g}, 2)["params"]
+    for a, b in zip(jax.tree.leaves(ref_stack),
+                    jax.tree.leaves(pp_state.pp_stages["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
 def test_pp_single_stage_degenerate(devices8):
     """pipe=1 degenerates to sequential microbatching — still bitwise."""
     mcfg, g, v, x = _setup(norm="batch", n_blocks=4)
