@@ -95,6 +95,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "programs from disk instead of recompiling; "
                         "hits/misses are counted through the obs retrace "
                         "watchdog")
+    p.add_argument("--elastic", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="elastic relaunch (docs/RESILIENCE.md): on resume, "
+                        "reconcile the checkpoint's recorded topology "
+                        "(process count, mesh axes, global batch, dtype "
+                        "policy) against this launch's and RESHARD "
+                        "compatible deltas — a preemptible fleet rarely "
+                        "hands back the slice size it reclaimed. On by "
+                        "default; --no-elastic restores the strict "
+                        "contract (any topology delta aborts)")
     # --- self-healing knobs (p2p_tpu.resilience.health) -------------------
     p.add_argument("--health", action=argparse.BooleanOptionalAction,
                    default=None,
@@ -263,7 +273,8 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  eval_fid=args.eval_fid, scan_steps=args.scan_steps,
                  pool_size=args.pool_size, save_masks=args.save_masks,
                  log_every=args.log_every,
-                 compilation_cache_dir=args.compilation_cache)
+                 compilation_cache_dir=args.compilation_cache,
+                 elastic=args.elastic)
     debug = over(cfg.debug, check_finite=args.check_finite,
                  nan_sentinel=args.nan_sentinel, grad_norms=args.grad_norms)
     health = over(cfg.health, enabled=args.health,
@@ -339,7 +350,17 @@ def main(argv=None) -> int:
 
         trainer.logger.registry.add_sink(PrometheusTextfileSink(
             args.prom_textfile, trainer.logger.registry))
-    resumed = trainer.maybe_resume()
+    from p2p_tpu.core.mesh import TopologyMismatch
+
+    try:
+        resumed = trainer.maybe_resume()
+    except TopologyMismatch as tm:
+        # an elastic relaunch hit a delta the resharded-resume path cannot
+        # reconcile (or --no-elastic forbade reconciling it). This is a
+        # flags problem, not a transient: exit 2, NOT 75 — "re-run these
+        # flags" would hit the same wall.
+        print(f"topology mismatch: {tm}", file=sys.stderr, flush=True)
+        return 2
     if resumed:
         print(f"resumed at epoch {trainer.epoch}")
     elif getattr(args, "phase", None) == "full":

@@ -264,6 +264,13 @@ class TrainConfig:
     # XLA compile only on the first run ever. None = off. The serving
     # engine (p2p_tpu.serve) has its own knob with the same plumbing.
     compilation_cache_dir: Optional[str] = None
+    # Elastic relaunch (docs/RESILIENCE.md "Elastic relaunch"): on resume,
+    # reconcile the checkpoint's recorded topology (process count, mesh
+    # axis sizes, global batch, dtype policy) against the current one and
+    # RESHARD compatible deltas — a preemptible-fleet relaunch may land on
+    # a different slice size. False = the strict pre-elastic contract:
+    # any topology delta aborts with a diagnostic instead of resharding.
+    elastic: bool = True
     # jax_debug_nans: first NaN-producing primitive raises with location.
     debug_nans: bool = False
     # The reference's commented "masking" experiment (train.py:324-334):

@@ -81,23 +81,169 @@ class MeshSpec:
     model: int = 1   # tensor-parallel axis (channel dims; parallel/tp.py)
     pipe: int = 1    # pipeline-parallel axis (trunk stages; parallel/pp.py)
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+    def resolve(self, n_devices: int,
+                context: str = "") -> tuple[int, int, int, int, int]:
+        """Concrete per-axis sizes for ``n_devices``.
+
+        ``context`` (optional) is appended to the failure diagnostics —
+        the elastic-relaunch path passes the topology the checkpoint was
+        saved on, so "my relaunch flags don't fit this slice" reads as
+        exactly that instead of a bare divisibility error.
+        """
         d, s, t, m, p = (self.data, self.spatial, self.time, self.model,
                          self.pipe)
         fixed = s * t * m * p
+        suffix = f"; {context}" if context else ""
         if d == -1:
             if n_devices % fixed:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by "
-                    f"spatial*time*model*pipe={fixed}"
+                    f"mesh data=-1,spatial={s},time={t},model={m},pipe={p} "
+                    f"cannot resolve: {n_devices} device(s) not divisible "
+                    f"by spatial*time*model*pipe={fixed} — pick axes whose "
+                    f"product divides the device count{suffix}"
                 )
             d = n_devices // fixed
         if d * s * t * m * p > n_devices:
             raise ValueError(
-                f"mesh {d}x{s}x{t}x{m}x{p} needs more than the {n_devices} "
-                "devices available"
+                f"mesh data={d},spatial={s},time={t},model={m},pipe={p} "
+                f"needs {d * s * t * m * p} devices but only {n_devices} "
+                f"are available — shrink an axis or use data=-1 (all "
+                f"remaining devices){suffix}"
             )
         return d, s, t, m, p
+
+
+class TopologyMismatch(ValueError):
+    """An elastic relaunch hit a topology delta the resharded-resume path
+    cannot reconcile (classified ``abort`` by
+    :func:`classify_topology_delta`), or elastic resume was disabled.
+    The message names the saved vs. current topology and what to change."""
+
+
+def mesh_topology(mesh: Optional[Mesh]) -> dict:
+    """The recorded topology block for the checkpoint aux sidecar: the
+    facts a relaunch must reconcile against before it can restore.
+
+    JSON-able on purpose — this rides the iterator-state sidecar
+    (train/checkpoint.py save_aux), not the Orbax tree."""
+    sizes = {str(a): int(s) for a, s in dict(mesh.shape).items()} \
+        if mesh is not None else {}
+    return {
+        "process_count": int(jax.process_count()),
+        "device_count": int(mesh.size) if mesh is not None
+        else len(jax.devices()),
+        "mesh": sizes,
+    }
+
+
+def describe_topology(topo: dict) -> str:
+    """One-line human form of a topology block (for diagnostics/logs)."""
+    mesh = topo.get("mesh") or {}
+    axes = ",".join(f"{a}={mesh[a]}" for a in mesh) or "none"
+    return (f"{topo.get('process_count', '?')} process(es) x "
+            f"{topo.get('device_count', '?')} device(s), mesh [{axes}], "
+            f"global_batch={topo.get('global_batch', '?')}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyDelta:
+    """Classification of a saved-vs-current topology difference.
+
+    ``kind``:
+    - ``"same"``    identical topology — the plain exact-step resume path
+    - ``"reshard"`` a compatible delta (process count, data/spatial/time
+      axis widths, device count): restore proceeds with target shardings
+      derived for the NEW mesh, and the per-host data skip re-derives
+      from the global step
+    - ``"abort"``   an incompatible delta (global batch, dtype policy,
+      pipe width, TP width under int8 amax state): resuming would corrupt
+      sample accounting or state semantics — fail with instructions
+    """
+
+    kind: str
+    reason: str
+
+
+def classify_topology_delta(saved: dict, current: dict,
+                            has_quant_state: bool = False) -> TopologyDelta:
+    """Reconcile a checkpoint's recorded topology block against the
+    relaunch's. Rules (the narrow, auditable core of elastic resume):
+
+    - ``global_batch`` change → abort: ``steps_per_epoch`` and the
+      optimizer trajectory both shift, so gapless sample accounting is
+      impossible — the step counter no longer names a sample position.
+    - dtype-policy change (``mixed_precision``/``moment_dtype``/
+      ``int8_delayed``) → abort: Orbax would silently cast, changing
+      numerics without a trace.
+    - ``pipe`` width change → abort: pp_split_state restructures the
+      TrainState tree itself, not just shardings.
+    - ``model`` (TP) width change under delayed-int8 quant state →
+      abort: the stored per-layer amax scales were calibrated against
+      the saved shard width.
+    - any other mesh-axis / process-count / device-count change →
+      reshard (params are replicated or rule-resharded over these axes;
+      the input pipeline re-derives per-host shards from the global
+      step).
+
+    Keys absent from ``saved`` (older sidecars) are treated as matching —
+    forward-compatible by construction.
+    """
+    def differs(key):
+        return key in saved and saved[key] != current.get(key)
+
+    for key, why in (
+        ("global_batch",
+         "the global batch size changed — steps_per_epoch and sample "
+         "accounting cannot line up; relaunch with the original "
+         "--batch_size"),
+        ("mixed_precision",
+         "the mixed-precision policy changed — restore would silently "
+         "cast the state; relaunch with the original precision flags"),
+        ("moment_dtype",
+         "the Adam moment storage dtype changed — restore would silently "
+         "cast the optimizer state; relaunch with the original "
+         "--moment_dtype"),
+        ("int8_delayed",
+         "the delayed-int8 policy changed — the TrainState tree differs "
+         "(quant collections); relaunch with the original --int8_delayed"),
+    ):
+        if differs(key):
+            return TopologyDelta("abort", why)
+    # A sidecar with no "mesh" key at all (pre-elastic) recorded nothing
+    # to reconcile mesh-wise — skip the axis comparisons. An EMPTY
+    # recorded mesh (a single-device save) is different: relaunching onto
+    # a real mesh is a legitimate reshard.
+    has_saved_mesh = "mesh" in saved
+    saved_mesh = saved.get("mesh") or {}
+    cur_mesh = current.get("mesh") or {}
+
+    def axis(block, name):
+        return int(block.get(name, 1))
+
+    if has_saved_mesh:
+        if axis(saved_mesh, PIPE_AXIS) != axis(cur_mesh, PIPE_AXIS):
+            return TopologyDelta(
+                "abort",
+                "the pipeline-parallel width changed — pp_split_state "
+                "restructures the TrainState tree; relaunch with the "
+                "original pipe axis")
+        if axis(saved_mesh, MODEL_AXIS) != axis(cur_mesh, MODEL_AXIS) \
+                and has_quant_state:
+            return TopologyDelta(
+                "abort",
+                "the tensor-parallel width changed under delayed-int8 amax "
+                "state — stored activation scales are calibrated per shard "
+                "width; relaunch with the original model axis (or resume "
+                "without --int8_delayed from a fresh run)")
+    changed = [k for k in ("process_count", "device_count")
+               if differs(k)]
+    if has_saved_mesh:
+        changed += [f"mesh.{a}" for a in set(saved_mesh) | set(cur_mesh)
+                    if axis(saved_mesh, a) != axis(cur_mesh, a)]
+    if changed:
+        return TopologyDelta(
+            "reshard", "topology delta: " + ", ".join(sorted(changed)))
+    return TopologyDelta("same", "identical topology")
 
 
 def make_mesh(

@@ -216,6 +216,85 @@ def _warn_fallback_workers(num_workers: int, registry=None) -> None:
     )
 
 
+def loader_kind() -> str:
+    """Which loader :func:`make_loader` will build on this process:
+    ``"grain"`` or ``"fallback"``. Recorded in the checkpoint topology
+    sidecar (train/loop.py ``trainer_topology``) because the elastic
+    MID-EPOCH reshard guarantee only holds for the fallback's stride
+    arithmetic: Grain's ShardByJaxProcess hands each process a
+    CONTIGUOUS block of record keys before shuffling, so no global epoch
+    permutation survives a process-count change — the reconciliation
+    (``plan_elastic_restore``) must abort rather than silently replay or
+    drop samples."""
+    if os.environ.get("P2P_TPU_NO_GRAIN") == "1":
+        return "fallback"
+    try:
+        import grain.python  # noqa: F401
+    except Exception:
+        return "fallback"
+    return "grain"
+
+
+def shard_epoch_indices(
+    idx: np.ndarray,
+    batch_size: int,
+    skip_batches: int = 0,
+    n_proc: Optional[int] = None,
+    pid: Optional[int] = None,
+    drop_remainder: bool = True,
+) -> list:
+    """THE per-host index arithmetic of the fallback loader: one epoch's
+    (already shuffled) global index vector → this host's batch-aligned,
+    post-skip slice. Factored out of :func:`make_loader` so the elastic
+    shard-accounting tests can drive it at ARBITRARY (n_proc, pid) pairs
+    — the exact production arithmetic, not a reimplementation.
+
+    Sharding is by stride (``idx[pid::n_proc]``, mirroring Grain's
+    ShardByJaxProcess): host ``p``'s shard position ``s`` is flat shuffled
+    position ``s*n_proc + p``. That makes the arithmetic ELASTIC: host
+    ``p``'s local batch ``i`` covers shard positions
+    ``[i*local_bs, (i+1)*local_bs)`` = flat positions
+    ``[i*local_bs*n_proc + p, ...]``, so the union over hosts of local
+    batch ``i`` is exactly flat positions ``[i*B, (i+1)*B)`` of the epoch
+    permutation (``B`` = global batch = ``local_bs * n_proc``) —
+    INDEPENDENT of ``n_proc``. A relaunch at a different process count
+    that skips ``skip_batches`` = (global mid-epoch step) local batches
+    per host therefore consumes exactly the samples the dead run did not,
+    zero duplicated, zero dropped — the gapless-accounting pin of
+    tests/test_data.py + test_multiprocess.py. The one precondition is a
+    FIXED global batch, which the topology reconciliation enforces
+    (core/mesh.classify_topology_delta classifies a global-batch delta
+    as must-abort).
+
+    With ``drop_remainder`` the pre-shard trim (``len % n_proc``) and the
+    per-host batch floor depend on ``n_proc`` only in the epoch TAIL —
+    samples no topology ever consumed: writing ``n = q*B + r`` (r < B),
+    every host gets exactly ``q`` full local batches regardless of
+    ``n_proc`` (shard length is ``q*local_bs + floor-of-(r/n_proc)`` and
+    ``r/n_proc < local_bs``), so steps-per-epoch is the topology-invariant
+    ``floor(n/B)``.
+    """
+    idx = np.asarray(idx)
+    if n_proc is None:
+        n_proc = jax.process_count()
+    if pid is None:
+        pid = jax.process_index()
+    if n_proc > 1:
+        if drop_remainder:
+            # equal-sized shards (Grain's drop_remainder semantics): an
+            # uneven split would hand one process an extra batch whose
+            # collectives the others never join — deadlock
+            idx = idx[: len(idx) - len(idx) % n_proc]
+        idx = idx[pid::n_proc]
+    if skip_batches > 0:
+        # resume mid-epoch: local batch i is shard rows [i·bs, (i+1)·bs),
+        # so dropping skip·bs leading indices leaves every later batch's
+        # membership and order IDENTICAL to an uninterrupted epoch — zero
+        # decodes spent on the skip
+        idx = idx[skip_batches * batch_size:]
+    return list(idx)
+
+
 def make_loader(
     dataset: PairedImageDataset,
     batch_size: int,
@@ -257,25 +336,14 @@ def make_loader(
                 idx = np.arange(len(dataset))
                 if shuffle:
                     rng.shuffle(idx)
-                # per-process record sharding, mirroring ShardByJaxProcess —
-                # the multi-process assembly path must never feed
-                # duplicated samples. With drop_remainder the shards must
-                # also be EQUAL-SIZED (Grain's drop_remainder semantics):
-                # an uneven split would hand one process an extra batch
-                # whose collectives the others never join — deadlock.
-                n_proc = jax.process_count()
-                if n_proc > 1:
-                    if drop_remainder:
-                        idx = idx[: len(idx) - len(idx) % n_proc]
-                    idx = idx[jax.process_index()::n_proc]
-                if skip:
-                    # resume mid-epoch: batch i is rows [i·bs, (i+1)·bs), so
-                    # dropping skip·bs leading indices leaves every later
-                    # batch's membership and order IDENTICAL to an
-                    # uninterrupted epoch — zero decodes spent on the skip
-                    idx = idx[skip * batch_size:]
-                    skip = 0
-                yield from _Stacked(dataset, batch_size, list(idx),
+                # per-process record sharding + mid-epoch skip — ONE
+                # arithmetic (shard_epoch_indices), shared with the
+                # elastic shard-accounting tests
+                local = shard_epoch_indices(
+                    idx, batch_size, skip_batches=skip,
+                    drop_remainder=drop_remainder)
+                skip = 0
+                yield from _Stacked(dataset, batch_size, local,
                                     drop_remainder)
                 epoch += 1
 

@@ -1,0 +1,100 @@
+"""Rule-driven partition-spec derivation over named state trees.
+
+The seed of the declarative sharding-rule engine (ROADMAP item 3, the
+regex-over-named-tree ``match_partition_rules`` pattern of SNIPPETS [1]/
+[2]): ONE ordered rule table — ``(regex, PartitionSpec)`` pairs matched
+against slash-joined leaf paths — produces the PartitionSpec tree for an
+arbitrary pytree (params, optimizer moments, or a whole TrainState; adam's
+mu/nu mirror the param paths, so one param rule covers all three).
+
+First consumer: the elastic resharded-resume path (train/loop.py
+``plan_elastic_restore``). A relaunch on a different slice derives the
+checkpoint's **target shardings for the NEW mesh** from rules instead of
+from the dead run's layout — today the table is narrow (replicate
+everything; Megatron channel shards via the TP pair rule when the model
+axis is real), but the derivation is already the single place a future
+FSDP/ZeRO rule-set plugs into.
+
+Scalars (and 1-element leaves) never partition — the universal floor rule
+the snippets agree on.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_tpu.core.mesh import MODEL_AXIS
+
+#: (regex, PartitionSpec) pairs, first match wins (re.search semantics).
+Rules = Sequence[Tuple[str, P]]
+
+#: The baseline table: fully-replicated state — correct for DP and for
+#: every mesh whose extra axes (spatial/time/pipe) shard activations, not
+#: parameters. TP layers its pair rule ON TOP via make_tp_rule.
+REPLICATED_RULES: Rules = ((r".*", P()),)
+
+
+def leaf_path_name(path) -> str:
+    """``jax.tree_util`` key path → slash-joined rule-matchable name,
+    e.g. ``params_g/down1/conv/kernel``."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules: Rules, tree: Any):
+    """PartitionSpec pytree for ``tree`` from an ordered rule table.
+
+    Every leaf must match some rule (append a ``(".*", P())`` catch-all
+    for replicate-by-default); an unmatched leaf raises — silently
+    replicating a leaf the table meant to shard is how layout bugs hide.
+    """
+
+    def spec_for(path, leaf):
+        name = leaf_path_name(path)
+        shape = np.shape(leaf) if not hasattr(leaf, "shape") else leaf.shape
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()  # never partition scalars
+        for rule, ps in rules:
+            if re.search(rule, name) is not None:
+                return ps
+        raise ValueError(f"no partition rule matched leaf {name!r} "
+                         f"(shape {tuple(shape)}) — add a catch-all rule")
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def state_target_shardings(state: Any, mesh: Mesh,
+                           rules: Optional[Rules] = None,
+                           tp_min_ch: int = 512):
+    """NamedSharding pytree: the restore-target layout of ``state`` on
+    ``mesh`` — the elastic resharded-restore's source of truth.
+
+    ``rules=None`` picks the layout the trainers actually run: the
+    Megatron TP tree when the mesh has a real model axis (delegating to
+    :func:`p2p_tpu.parallel.tp.tp_sharding_tree`, whose pair rule is
+    shape-conditional — outside the regex table's reach until rules grow
+    predicates), fully replicated otherwise.
+    """
+    if rules is None:
+        if mesh.shape.get(MODEL_AXIS, 1) > 1:
+            from p2p_tpu.parallel.tp import tp_sharding_tree
+
+            return tp_sharding_tree(state, mesh, min_ch=tp_min_ch)
+        rules = REPLICATED_RULES
+    specs = match_partition_rules(rules, state)
+    return jax.tree_util.tree_map(lambda ps: NamedSharding(mesh, ps), specs,
+                                  is_leaf=lambda x: isinstance(x, P))

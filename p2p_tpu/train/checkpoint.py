@@ -78,6 +78,34 @@ def _abstract(leaf):
     return ocp.utils.to_shape_dtype_struct(leaf)
 
 
+def peek_topology(directory: str) -> Optional[Dict[str, Any]]:
+    """The newest step's recorded topology block from ``<directory>.aux``,
+    without constructing a :class:`CheckpointManager` (which would create
+    directories). Used by the trainers to enrich mesh-resolve failures on
+    relaunch: "your --mesh doesn't fit this slice; the checkpoint was
+    saved on <topology>". None when no sidecar names one (fresh run,
+    pre-elastic checkpoints, unreadable/corrupt sidecars)."""
+    aux_dir = os.path.abspath(directory) + ".aux"
+    try:
+        names = os.listdir(aux_dir)
+    except OSError:
+        return None
+    steps = []
+    for n in names:
+        stem, dot, ext = n.partition(".")
+        if dot and ext == "json" and stem.isdigit():
+            steps.append(int(stem))
+    for s in sorted(steps, reverse=True):
+        try:
+            with open(os.path.join(aux_dir, f"{s}.json")) as f:
+                topo = json.load(f).get("topology")
+        except (OSError, json.JSONDecodeError):
+            continue
+        if topo:
+            return topo
+    return None
+
+
 def _leaf_checksums(tree: Any) -> Optional[Dict[str, Dict[str, Any]]]:
     """``{leaf_path: {crc32, shape, dtype}}`` over a pytree's arrays.
 
@@ -121,6 +149,7 @@ class CheckpointManager:
                  registry=None):
         directory = os.path.abspath(directory)
         os.makedirs(directory, exist_ok=True)
+        self.directory = directory
         self._aux_dir = directory + ".aux"
         # retry/chaos counters land here (None = the process default
         # registry); the trainers pass their run's registry so checkpoint
@@ -183,7 +212,7 @@ class CheckpointManager:
 
     def restore(self, state_template: TrainState,
                 step: Optional[int] = None, verify: bool = True,
-                fallback: Optional[bool] = None):
+                fallback: Optional[bool] = None, shardings=None):
         """Restore into the structure/sharding of ``state_template``.
 
         ``step=None`` restores the newest step; the restored leaves are
@@ -196,6 +225,15 @@ class CheckpointManager:
         ``fallback=True``. Raises :class:`CheckpointCorrupt`
         (non-retryable) when nothing intact remains in scope,
         ``FileNotFoundError`` when the step (or any step) is absent.
+
+        ``shardings`` (a NamedSharding pytree matching the template)
+        switches on the RESHARDED restore: the elastic-relaunch path
+        (train/loop.py ``plan_elastic_restore``) passes target shardings
+        derived for the NEW mesh — rule-driven, parallel/rules.py — and
+        Orbax performs the cross-topology load, landing every leaf
+        already laid out for the relaunch's topology rather than the
+        (possibly dead) one that wrote the checkpoint. Counted on
+        ``resharded_restore_total``.
         """
         if fallback is None:
             fallback = step is None
@@ -212,8 +250,17 @@ class CheckpointManager:
             steps = steps[-1:]
         if not steps:
             raise FileNotFoundError("no checkpoint found")
-        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
-                                          state_template)
+        if shardings is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.ShapeDtypeStruct(
+                    np.shape(leaf) if not hasattr(leaf, "shape")
+                    else leaf.shape,
+                    getattr(leaf, "dtype", np.asarray(leaf).dtype),
+                    sharding=sh),
+                state_template, shardings)
+        else:
+            abstract = jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, state_template)
         tried: List[int] = []
         last_exc: Optional[BaseException] = None
         for s in reversed(steps):
@@ -245,6 +292,10 @@ class CheckpointManager:
                         + ("..." if len(bad) > 3 else ""))
                     continue
             self.last_restored_step = s
+            if shardings is not None:
+                # counted only on SUCCESS — the audit counter must name
+                # resharded restores that happened, not ones attempted
+                self._reg().counter("resharded_restore_total").inc()
             return restored
         raise CheckpointCorrupt(str(self._mgr.directory), tried,
                                 last_error=last_exc) from last_exc
@@ -329,13 +380,31 @@ class CheckpointManager:
                    registry=self._registry)
 
     def _read_aux_json(self, name: str) -> Optional[Dict[str, Any]]:
+        """Sidecar JSON, or None when absent — or when PRESENT but
+        unparseable. The atomic tmp+rename write should make torn
+        sidecars impossible, but a hard kill can still half-write on
+        filesystems without atomic rename (or leave bit rot): a corrupt
+        sidecar degrades to "missing" — resume falls back to the
+        position derived from the step counter (epoch-boundary exact,
+        mid-epoch best-effort) instead of dying on JSONDecodeError —
+        and the degradation is COUNTED (``aux_corrupt_total`` + a
+        ``kind="aux_corrupt"`` record), never silent."""
         path = os.path.join(self._aux_dir, name)
         if not os.path.exists(path):
             return None
         try:
             with open(path) as f:
                 return json.load(f)
-        except (OSError, json.JSONDecodeError):
+        except json.JSONDecodeError as exc:
+            reg = self._reg()
+            reg.counter("aux_corrupt_total").inc()
+            reg.record({"kind": "aux_corrupt", "file": name,
+                        "reason": repr(exc)[:200]}, force=True)
+            print(f"WARNING: checkpoint sidecar {name} is corrupt "
+                  f"({exc}) — treating as missing (resume falls back to "
+                  "step-derived position)", flush=True)
+            return None
+        except OSError:
             return None
 
     def save_aux(self, step: int, payload: Dict[str, Any]) -> None:

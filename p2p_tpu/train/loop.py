@@ -25,11 +25,21 @@ turns into exit code 75. ``maybe_resume`` reverses it: a mid-epoch step
 resumes its epoch at the exact next batch (``make_loader(skip_batches=)``)
 so no sample is replayed or skipped — pinned bitwise-equal to an
 uninterrupted run by tests/test_resilience.py.
+
+Elastic relaunch (docs/RESILIENCE.md "Elastic relaunch"): the sidecar also
+records the run's TOPOLOGY (process count, mesh axis sizes, global batch,
+dtype policy); ``maybe_resume`` reconciles it against the relaunch's via
+:func:`~p2p_tpu.core.mesh.classify_topology_delta` — a compatible delta
+(different slice size, different data-axis width) restores RESHARDED onto
+the new mesh with rule-derived target shardings (parallel/rules.py) and
+re-derives every host's data-shard offset from the global step, so a
+preemptible fleet can resume on whatever capacity the scheduler grants.
 """
 
 from __future__ import annotations
 
 import os
+import signal
 import time
 from typing import Dict, List, Optional
 
@@ -49,6 +59,7 @@ from p2p_tpu.obs import (
     write_manifest,
 )
 from p2p_tpu.resilience import Preempted, PreemptionGuard
+from p2p_tpu.resilience.chaos import FaultInjected, chaos_point
 from p2p_tpu.resilience.health import DivergenceError
 from p2p_tpu.train.checkpoint import CheckpointCorrupt, CheckpointManager
 from p2p_tpu.train.schedules import PlateauController
@@ -113,6 +124,28 @@ def close_trainer_obs(tr) -> None:
         tr._sentinel_handler = None
 
 
+def trainer_topology(tr) -> Dict:
+    """The topology block recorded in the sidecar AND reconciled against
+    on relaunch (core/mesh.classify_topology_delta): mesh axis sizes +
+    process/device counts, plus the cross-cutting facts a reshard cannot
+    paper over — the global batch (sample accounting) and the dtype
+    policy (a silent Orbax cast would change numerics untraceably)."""
+    from p2p_tpu.core.mesh import mesh_topology
+    from p2p_tpu.data.pipeline import loader_kind
+
+    topo = mesh_topology(tr.mesh)
+    topo.update({
+        "global_batch": int(tr.cfg.data.batch_size),
+        "mixed_precision": bool(tr.cfg.train.mixed_precision),
+        "moment_dtype": tr.cfg.optim.moment_dtype,
+        "int8_delayed": bool(tr.cfg.model.int8_delayed),
+        # mid-epoch reshard is only exact under the fallback loader's
+        # stride arithmetic — plan_elastic_restore gates on this
+        "loader": loader_kind(),
+    })
+    return topo
+
+
 def save_trainer_ckpt(tr, wait: bool = False) -> int:
     """Checkpoint the trainer's TrainState AND the data-iterator sidecar
     (epoch, in-epoch batch position, aug seed) — together they name an
@@ -135,6 +168,11 @@ def save_trainer_ckpt(tr, wait: bool = False) -> int:
         # become permanent across a preempt/resume
         "seed_jitter": int(getattr(tr, "_seed_jitter", 0)),
         "lr_base": float(getattr(tr, "_base_lr_scale", 1.0)),
+        # elastic relaunch: the topology this checkpoint was written on —
+        # maybe_resume reconciles it against the relaunch's and reshards
+        # compatible deltas (a preemptible fleet rarely hands back the
+        # same slice size it reclaimed)
+        "topology": trainer_topology(tr),
     })
     return step
 
@@ -158,7 +196,10 @@ def finish_preempted(tr) -> None:
     raise Preempted(step, getattr(guard, "signum", None))
 
 
-def derive_resume_position(tr, step: int):
+_AUX_UNREAD = object()
+
+
+def derive_resume_position(tr, step: int, aux=_AUX_UNREAD):
     """``(done_full_epochs, mid_batches)`` for a restored checkpoint step,
     shared by both trainers' ``maybe_resume``.
 
@@ -167,9 +208,15 @@ def derive_resume_position(tr, step: int):
     disagreeing on steps_per_epoch means the dataset or batch size changed
     under the checkpoint, where the sidecar's recorded position is the
     ground truth. Sets ``tr._resume_skip`` and logs the ``kind="resume"``
-    record for mid-epoch re-entries."""
+    record for mid-epoch re-entries.
+
+    ``aux`` lets maybe_resume pass the sidecar it already read for this
+    step (None = read but missing/corrupt — a torn sidecar's
+    ``aux_corrupt_total`` bump must happen once, not once per consumer);
+    left unset, the sidecar is read here (rollback path)."""
     done, mid = divmod(int(step), tr.steps_per_epoch)
-    aux = tr.ckpt.restore_aux(int(step))
+    if aux is _AUX_UNREAD:
+        aux = tr.ckpt.restore_aux(int(step))
     if aux is not None and aux.get("seed_jitter") is not None:
         # a post-rollback run shuffles on a perturbed seed; the relaunch
         # must re-derive it or the skip below would drop batches of a
@@ -208,6 +255,164 @@ def derive_resume_position(tr, step: int):
             force=True,
         )
     return done, mid
+
+
+def plan_elastic_restore(tr, step: int, aux):
+    """Reconcile the checkpoint's recorded topology with this relaunch's
+    BEFORE the restore touches Orbax; shared by both trainers'
+    ``maybe_resume``.
+
+    Returns the target-sharding pytree for
+    :meth:`CheckpointManager.restore` — None for a same-topology (or
+    pre-elastic) checkpoint, a rule-derived NamedSharding tree for the
+    NEW mesh when the delta classifies as a compatible reshard. Raises
+    :class:`~p2p_tpu.core.mesh.TopologyMismatch` (with the saved and
+    current topologies spelled out) on a must-abort delta, on a
+    mid-epoch reshard under the Grain loader (its contiguous-block
+    sharding has no topology-invariant epoch permutation — accounting
+    would silently drift), or on ANY delta under ``--no-elastic``.
+
+    ``aux`` is the step's already-read sidecar (maybe_resume reads it
+    once and threads it through — a torn sidecar must be counted once,
+    not once per consumer).
+    """
+    from p2p_tpu.core.mesh import (
+        TopologyMismatch,
+        classify_topology_delta,
+        describe_topology,
+    )
+
+    saved = (aux or {}).get("topology")
+    if not saved:
+        # torn/missing sidecar for THIS step: the newest intact sidecar
+        # still names the run's layout — a half-written JSON must not
+        # bypass the must-abort classification (global batch, dtype)
+        from p2p_tpu.train.checkpoint import peek_topology
+
+        saved = peek_topology(tr.ckpt.directory)
+    if not saved:
+        # pre-elastic checkpoint: nothing recorded to reconcile — the
+        # template's own layout rules
+        return None
+    current = trainer_topology(tr)
+    has_quant = bool(jax.tree_util.tree_leaves(
+        tuple(getattr(tr.state, f, None)
+              for f in ("quant_g", "quant_d", "quant_c"))))
+    delta = classify_topology_delta(saved, current,
+                                    has_quant_state=has_quant)
+    if delta.kind == "same":
+        return None
+    detail = (f"saved: {describe_topology(saved)}; "
+              f"current: {describe_topology(current)}")
+    if delta.kind == "abort":
+        raise TopologyMismatch(
+            f"cannot resume across this topology change — {delta.reason} "
+            f"({detail})")
+    if not tr.cfg.train.elastic:
+        raise TopologyMismatch(
+            f"topology changed with elastic resume disabled — "
+            f"{delta.reason} ({detail}); relaunch on the original "
+            "topology, or drop --no-elastic to reshard")
+    mid = int(aux["batches_done"]) if aux and \
+        aux.get("batches_done") is not None \
+        else int(step) % tr.steps_per_epoch
+    if mid and "grain" in (saved.get("loader"), current.get("loader")):
+        raise TopologyMismatch(
+            "mid-epoch resume across a topology change is only exact "
+            "under the fallback loader's stride sharding — the Grain "
+            "loader shards contiguous record blocks per process, so the "
+            "interrupted epoch's consumed prefix cannot be re-derived on "
+            f"a different topology ({detail}); relaunch on the original "
+            "topology, or run with P2P_TPU_NO_GRAIN=1 for elastic-exact "
+            "accounting")
+    tr.obs.counter("elastic_resume_total").inc()
+    tr.logger.log(
+        {"kind": "elastic_resume", "step": int(step),
+         "decision": delta.kind, "reason": delta.reason,
+         "saved": saved, "current": current},
+        force=True,
+    )
+    print(f"elastic resume: {delta.reason} — resharding the step-{step} "
+          f"checkpoint onto the current topology ({detail})", flush=True)
+    if tr.mesh is None:
+        return None  # single-device template: its layout is the target
+    from p2p_tpu.parallel.rules import state_target_shardings
+
+    return state_target_shardings(
+        tr.state, tr.mesh, tp_min_ch=tr.cfg.parallel.tp_min_ch)
+
+
+def finish_elastic_restore(tr, step: int, shardings) -> None:
+    """Post-restore accounting for a resharded resume: one auditable
+    record naming the count (the CI elastic smoke asserts on it)."""
+    if shardings is None:
+        return
+    tr.logger.log(
+        {"kind": "resharded_restore", "step": int(step),
+         "resharded_restore_total":
+             tr.obs.counter("resharded_restore_total").value},
+        force=True,
+    )
+
+
+def build_trainer_mesh(cfg, workdir: str):
+    """``make_mesh(cfg.parallel.mesh)`` with elastic-relaunch context: a
+    resolve failure (axes don't fit the current device count — the classic
+    relaunch-on-a-smaller-slice mistake) names the topology the run's
+    checkpoint was saved on, when one exists, instead of a bare
+    divisibility error. Shared by both trainers."""
+    from p2p_tpu.core.mesh import describe_topology
+
+    try:
+        return make_mesh(cfg.parallel.mesh)
+    except ValueError as e:
+        from p2p_tpu.train.checkpoint import peek_topology
+
+        ckpt_dir = os.path.join(
+            workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name)
+        saved = peek_topology(ckpt_dir)
+        if saved is not None:
+            raise ValueError(
+                f"{e} [relaunch context: the checkpoint under {ckpt_dir} "
+                f"was saved on {describe_topology(saved)}; an elastic "
+                "relaunch may change the topology, but the new mesh must "
+                "fit the devices this launch actually has]") from e
+        raise
+
+
+def metrics_path(workdir: str, name: str) -> str:
+    """Per-process metrics JSONL path. Process 0 keeps the canonical
+    ``metrics_<name>.jsonl``; other processes write a ``.pN`` sibling —
+    multi-host runs share one workdir (the checkpoint dir must be
+    common), and two processes appending to one JSONL interleave torn
+    records."""
+    idx = jax.process_index()
+    suffix = "" if idx == 0 else f".p{idx}"
+    return os.path.join(workdir, f"metrics_{name}{suffix}.jsonl")
+
+
+def poll_preempt(tr) -> bool:
+    """Step-boundary preemption poll shared by both trainers, fronted by
+    the ``elastic`` chaos seam: when armed (``P2P_CHAOS=elastic@N``) the
+    seam converts a deterministic host step into a synthetic preemption
+    request — the elastic-relaunch rehearsals (CI, tests) kill a run
+    mid-epoch at an exact step with no signal-timing races, then relaunch
+    it on a different topology. Returns True when the (cross-host agreed)
+    stop should fire."""
+    if tr.preempt is None:
+        return False
+    try:
+        chaos_point("elastic", step=tr._host_step)
+    except FaultInjected:
+        # Deterministic by construction: every host runs the same
+        # dispatch count, so the seam fires at the SAME step on all of
+        # them — no agreement collective needed (and none would come in
+        # time: the amortized cadence waits up to sync_every polls, which
+        # a short rehearsal epoch may never reach). Real signals stay on
+        # the agreed path below.
+        tr.preempt.request(signal.SIGTERM)
+        return True
+    return tr.preempt.should_stop()
 
 
 def acquire_preempt_guard(tr):
@@ -507,7 +712,7 @@ class Trainer:
         )
         self.steps_per_epoch = max(1, len(self.train_ds) // cfg.data.batch_size)
         self.mesh = mesh if mesh is not None else (
-            make_mesh(cfg.parallel.mesh) if use_mesh else None
+            build_trainer_mesh(cfg, workdir) if use_mesh else None
         )
         self._tp = False
         if self.mesh is not None:
@@ -622,7 +827,7 @@ class Trainer:
             workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
         )
         self.logger = MetricsLogger(
-            os.path.join(workdir, f"metrics_{cfg.name}.jsonl"),
+            metrics_path(workdir, cfg.name),
             cfg.train.log_every,
         )
         self.obs = self.logger.registry
@@ -733,8 +938,16 @@ class Trainer:
         step = self.ckpt.latest_step()
         if step is None:
             return False
+        # the step's sidecar, read ONCE for every consumer below (a torn
+        # one must bump aux_corrupt_total once, not once per reader)
+        aux = self.ckpt.restore_aux(int(step))
+        # Elastic relaunch: reconcile the sidecar's recorded topology with
+        # this launch's BEFORE touching Orbax — a compatible delta restores
+        # resharded onto the new mesh; an incompatible one aborts with the
+        # two topologies spelled out instead of a deep restore error.
+        shardings = plan_elastic_restore(self, int(step), aux)
         try:
-            self.state = self.ckpt.restore(self.state)
+            self.state = self.ckpt.restore(self.state, shardings=shardings)
         except CheckpointCorrupt as e:
             if self.cfg.health.ema_decay is not None:
                 # the likeliest cause: --ema_decay was ADDED over a
@@ -749,13 +962,17 @@ class Trainer:
             raise
         # integrity fallback may have restored an OLDER intact step than
         # latest — position bookkeeping must follow the ACTUAL weights
-        if self.ckpt.last_restored_step is not None:
+        # (including which step's sidecar is the ground truth)
+        if self.ckpt.last_restored_step is not None \
+                and int(self.ckpt.last_restored_step) != int(step):
             step = self.ckpt.last_restored_step
+            aux = self.ckpt.restore_aux(int(step))
+        finish_elastic_restore(self, int(step), shardings)
         # Exact-step resume: a mid-epoch (preemption) checkpoint re-enters
         # its epoch at batch `mid` — the loader skips exactly the batches
         # the killed run consumed (same shuffle: the epoch seed is a pure
         # function of the epoch label).
-        done, mid = derive_resume_position(self, int(step))
+        done, mid = derive_resume_position(self, int(step), aux=aux)
         # --epoch_count N means "continue labeling at epoch N" (reference
         # train.py:137,253-255); without it the restored step names the
         # epoch. `1 + done` covers both boundary and mid-epoch resumes: a
@@ -785,7 +1002,6 @@ class Trainer:
         # the restored lr_scale may carry a transient cooldown factor
         # (preempted mid-cooldown); the sidecar's lr_base names the real
         # plateau scale — reset to it so the 10x reduction isn't permanent
-        aux = self.ckpt.restore_aux(int(step))
         base = (aux or {}).get("lr_base")
         if base is not None \
                 and float(np.asarray(self.state.lr_scale)) != float(base):
@@ -963,9 +1179,10 @@ class Trainer:
                 break
             # Preemption poll at the step boundary (cross-host agreed —
             # every process runs the same dispatch count, so the agreement
-            # collective stays aligned). The flag is only SET here; fit()
-            # owns the save-and-exit policy.
-            if self.preempt is not None and self.preempt.should_stop():
+            # collective stays aligned), fronted by the `elastic` chaos
+            # seam. The flag is only SET here; fit() owns the
+            # save-and-exit policy.
+            if poll_preempt(self):
                 self._preempted = True
                 break
         # drain the delayed sentinel slot: the epoch's last dispatch must

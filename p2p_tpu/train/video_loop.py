@@ -29,15 +29,20 @@ from p2p_tpu.train.checkpoint import CheckpointManager
 from p2p_tpu.train.loop import (
     acquire_preempt_guard,
     apply_health_lr,
+    build_trainer_mesh,
     close_trainer_obs,
     derive_resume_position,
     epoch_metric_means,
+    finish_elastic_restore,
     finish_preempted,
     flush_health_observations,
     init_trainer_obs,
     log_health_summary,
     mask_skipped_metrics,
+    metrics_path,
     perform_rollback,
+    plan_elastic_restore,
+    poll_preempt,
     queue_health_observation,
     release_preempt_guard,
     save_trainer_ckpt,
@@ -98,7 +103,7 @@ class VideoTrainer:
         self.test_ds = VideoClipDataset(root, "test", **kw)
         self.steps_per_epoch = max(1, len(self.train_ds) // cfg.data.batch_size)
         self.mesh = mesh if mesh is not None else (
-            make_mesh(cfg.parallel.mesh) if use_mesh else None
+            build_trainer_mesh(cfg, workdir) if use_mesh else None
         )
         self.clip_sharding = video_sharding(self.mesh) if self.mesh else None
         # global batch in cfg; per-process local batch for the loaders
@@ -131,7 +136,7 @@ class VideoTrainer:
             PlateauController() if cfg.optim.lr_policy == "plateau" else None
         )
         self.logger = MetricsLogger(
-            os.path.join(workdir, f"metrics_{cfg.name}.jsonl"),
+            metrics_path(workdir, cfg.name),
             cfg.train.log_every,
         )
         self.obs = self.logger.registry
@@ -181,14 +186,23 @@ class VideoTrainer:
         step = self.ckpt.latest_step()
         if step is None:
             return False
-        self.state = self.ckpt.restore(self.state)
+        # the step's sidecar, read ONCE for every consumer below
+        aux = self.ckpt.restore_aux(int(step))
+        # elastic relaunch: reconcile recorded vs current topology first
+        # (cf. Trainer.maybe_resume) — reshard compatible deltas, abort
+        # incompatible ones with both topologies named
+        shardings = plan_elastic_restore(self, int(step), aux)
+        self.state = self.ckpt.restore(self.state, shardings=shardings)
         # integrity fallback may have restored an OLDER intact step
-        if self.ckpt.last_restored_step is not None:
+        if self.ckpt.last_restored_step is not None \
+                and int(self.ckpt.last_restored_step) != int(step):
             step = self.ckpt.last_restored_step
+            aux = self.ckpt.restore_aux(int(step))
+        finish_elastic_restore(self, int(step), shardings)
         # exact-step resume (shared with Trainer.maybe_resume): a
         # mid-epoch (preemption) checkpoint re-enters its epoch at
         # clip-batch `mid`
-        done, mid = derive_resume_position(self, int(step))
+        done, mid = derive_resume_position(self, int(step), aux=aux)
         self.epoch = max(self.cfg.train.epoch_count, 1 + done)
         # Renormalize the schedule's epoch offset against the restored
         # step (see Trainer.maybe_resume for the double-offset analysis;
@@ -203,7 +217,6 @@ class VideoTrainer:
             )
             self._build_step_fns()
         # drop a preempt-frozen transient cooldown factor (cf. Trainer)
-        aux = self.ckpt.restore_aux(int(step))
         base = (aux or {}).get("lr_base")
         if base is not None \
                 and float(np.asarray(self.state.lr_scale)) != float(base):
@@ -329,8 +342,9 @@ class VideoTrainer:
             # recovery ladder rung 3 (cf. Trainer.train_epoch)
             if self.health is not None and self.health.rollback_pending:
                 break
-            # preemption poll at the step boundary (cf. Trainer.train_epoch)
-            if self.preempt is not None and self.preempt.should_stop():
+            # preemption poll at the step boundary, fronted by the
+            # `elastic` chaos seam (cf. Trainer.train_epoch)
+            if poll_preempt(self):
                 self._preempted = True
                 break
         flush_health_observations(self)

@@ -31,6 +31,12 @@ def main() -> int:
     # same platform dance as mp_worker.py: the sitecustomize hook pins the
     # TPU tunnel; force CPU on the live config before backend init
     jax.config.update("jax_platforms", "cpu")
+    try:
+        # cross-process CPU collectives need the gloo implementation on
+        # jax 0.4.x (later releases ship it as the default)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=nproc,

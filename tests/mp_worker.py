@@ -42,6 +42,12 @@ def main() -> int:
     # too late, so force the CPU platform on the live config, BEFORE the
     # backend initializes (same dance as tests/conftest.py).
     jax.config.update("jax_platforms", "cpu")
+    try:
+        # cross-process CPU collectives need the gloo implementation on
+        # jax 0.4.x (later releases ship it as the default)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=nproc,

@@ -323,3 +323,130 @@ def test_generate_dataset_rectangular_crop(tmp_path):
     np.testing.assert_array_equal(a0, arr[:32, :64])
     b0 = np.asarray(Image.open(out / "train" / "b" / a_files[0]))
     np.testing.assert_array_equal(b0, compress_uint8(a0, 3))
+
+
+# ---------------------------------------------------------------------------
+# Elastic shard arithmetic (tests ISSUE satellite: make_loader(skip_batches=)
+# when jax.process_count() differs from the run that wrote the sidecar)
+
+
+def _consumed_by(perm, global_bs, n_proc, first=0, until=None,
+                 drop_remainder=True):
+    """Samples consumed by global steps [first, until) of one epoch at a
+    given process count, through the PRODUCTION arithmetic
+    (shard_epoch_indices + the per-host batch floor)."""
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    local_bs = global_bs // n_proc
+    out = []
+    for pid in range(n_proc):
+        local = shard_epoch_indices(
+            np.asarray(perm), local_bs, skip_batches=first,
+            n_proc=n_proc, pid=pid, drop_remainder=drop_remainder)
+        n_batches = len(local) // local_bs if drop_remainder else None
+        stop = until - first if until is not None else n_batches
+        if drop_remainder:
+            stop = min(stop, n_batches)
+        out.extend(local[: stop * local_bs] if stop is not None else local)
+    return out
+
+
+def test_shard_epoch_indices_global_step_invariant_across_process_counts():
+    """THE elastic-accounting law: with stride sharding, global step i
+    consumes exactly flat shuffled positions [i*B, (i+1)*B) — independent
+    of the process count. A relaunch at a DIFFERENT process count that
+    skips the sidecar's global mid-epoch step therefore consumes exactly
+    the dead run's unconsumed tail: zero duplicated, zero dropped."""
+    rng = np.random.default_rng(7)
+    n, B = 48, 8
+    perm = rng.permutation(n)
+    spe = n // B
+    for n_proc in (1, 2, 4, 8):
+        for step in range(spe + 1):
+            prefix = _consumed_by(perm, B, n_proc, first=0, until=step)
+            assert sorted(prefix) == sorted(perm[: step * B].tolist()), (
+                f"n_proc={n_proc} step={step}")
+
+
+def test_skip_rederived_after_process_count_change_is_gapless():
+    """Mid-epoch kill at P_old processes, relaunch at P_new: the prefix
+    the dead run consumed plus the relaunch's post-skip tail must cover
+    the epoch's consumable records EXACTLY once — including an uneven
+    dataset tail (n % B != 0) that drop_remainder trims identically under
+    every topology."""
+    rng = np.random.default_rng(11)
+    n, B = 37, 6            # uneven tail: 37 = 6*6 + 1
+    perm = rng.permutation(n)
+    spe = n // B
+    for p_old in (1, 2, 3, 6):
+        for p_new in (1, 2, 3, 6):
+            for mid in (0, 1, 3, spe - 1):
+                before = _consumed_by(perm, B, p_old, first=0, until=mid)
+                after = _consumed_by(perm, B, p_new, first=mid)
+                got = sorted(before + after)
+                want = sorted(perm[: spe * B].tolist())
+                assert got == want, (
+                    f"p_old={p_old} p_new={p_new} mid={mid}: "
+                    "replayed or dropped samples across the topology change")
+
+
+def test_shard_epoch_indices_per_host_batch_floor_is_topology_invariant():
+    """Every host gets exactly floor(n/B) full local batches regardless of
+    the process count (writing n = q*B + r with r < B: the shard is
+    q*local_bs + floor-of-tail and the tail is < local_bs) — so
+    steps_per_epoch derived from the GLOBAL batch stays aligned with what
+    the loaders actually yield under any topology."""
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    for n in (12, 13, 17, 24, 25, 37):
+        for B in (4, 6, 12):
+            for n_proc in (1, 2, 4):
+                if B % n_proc:
+                    continue
+                local_bs = B // n_proc
+                for pid in range(n_proc):
+                    local = shard_epoch_indices(
+                        np.arange(n), local_bs, n_proc=n_proc, pid=pid)
+                    assert len(local) // local_bs == n // B, (n, B, n_proc)
+
+
+def test_shard_epoch_indices_no_drop_remainder_covers_every_record():
+    """drop_remainder=False (eval single-process semantics): no pre-shard
+    trim — the host shards partition ALL n records exactly once, uneven
+    tails included."""
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    n = 11
+    for n_proc in (1, 2, 3):
+        allv = []
+        for pid in range(n_proc):
+            allv += shard_epoch_indices(np.arange(n), 2, n_proc=n_proc,
+                                        pid=pid, drop_remainder=False)
+        assert sorted(allv) == list(range(n)), n_proc
+
+
+def test_make_loader_fallback_uses_shard_arithmetic(tmp_path, monkeypatch):
+    """The fallback loader and shard_epoch_indices are ONE arithmetic:
+    batches yielded under a simulated 2-process environment match the
+    helper's slice for the same (seed, skip)."""
+    import jax
+
+    make_synthetic_dataset(str(tmp_path), n_train=12, n_test=0, size=16)
+    ds = PairedImageDataset(str(tmp_path), image_size=16)
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    rng = np.random.default_rng(5)
+    perm = np.arange(len(ds))
+    rng.shuffle(perm)
+    want = shard_epoch_indices(perm, 2, skip_batches=1, n_proc=2, pid=1)
+
+    got_batches = list(make_loader(ds, 2, shuffle=True, seed=5,
+                                   num_epochs=1, skip_batches=1))
+    assert len(got_batches) == len(want) // 2
+    flat = np.concatenate([b["input"] for b in got_batches])
+    ref = np.stack([ds[int(i)]["input"] for i in want])
+    np.testing.assert_array_equal(flat, ref)

@@ -1,0 +1,479 @@
+"""Elastic preemptible-fleet resume (cross-topology resharded restore).
+
+Unit level: topology recording + delta classification (core/mesh),
+actionable MeshSpec.resolve diagnostics, sidecar topology peek,
+corrupt-sidecar degradation (restore_aux must treat a half-written JSON
+as missing, counted — never a JSONDecodeError crash), and the rule-driven
+target-sharding derivation (parallel/rules) that seeds the declarative
+partitioner.
+
+Integration level (the acceptance pin): a run preempted mid-epoch on a
+``data=2`` mesh and resumed on a ``data=4`` mesh restores params
+BITWISE-equal to a same-topology restore of the same step, re-enters the
+interrupted epoch at the same position, completes, and the reshard is
+auditable (``kind=elastic_resume``/``resharded_restore`` records +
+``resharded_restore_total``). The cross-PROCESS-COUNT twin (a real
+2-process run killed mid-epoch and relaunched single-process on a
+different data-axis width, gapless) lives in tests/test_kill_resume.py.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from p2p_tpu.core.mesh import (
+    MeshSpec,
+    TopologyMismatch,
+    classify_topology_delta,
+    describe_topology,
+    make_mesh,
+    mesh_topology,
+)
+
+# ------------------------------------------------- delta classification
+
+
+def _topo(**over):
+    base = {
+        "process_count": 1, "device_count": 4,
+        "mesh": {"data": 4, "spatial": 1, "time": 1, "model": 1, "pipe": 1},
+        "global_batch": 8, "mixed_precision": True,
+        "moment_dtype": "float32", "int8_delayed": False,
+    }
+    base.update(over)
+    return base
+
+
+def test_classify_identical_topology_is_same():
+    d = classify_topology_delta(_topo(), _topo())
+    assert d.kind == "same"
+
+
+@pytest.mark.parametrize("over", [
+    {"process_count": 2},
+    {"device_count": 8},
+    {"mesh": {"data": 2, "spatial": 1, "time": 1, "model": 1, "pipe": 1}},
+    {"mesh": {"data": 2, "spatial": 2, "time": 1, "model": 1, "pipe": 1}},
+])
+def test_classify_capacity_deltas_reshard(over):
+    d = classify_topology_delta(_topo(), _topo(**over))
+    assert d.kind == "reshard", d
+    assert "topology delta" in d.reason
+
+
+@pytest.mark.parametrize("over,needle", [
+    ({"global_batch": 4}, "--batch_size"),
+    ({"mixed_precision": False}, "precision"),
+    ({"moment_dtype": "bfloat16"}, "--moment_dtype"),
+    ({"int8_delayed": True}, "--int8_delayed"),
+    ({"mesh": {"data": 2, "spatial": 1, "time": 1, "model": 1, "pipe": 2}},
+     "pipeline-parallel"),
+])
+def test_classify_semantic_deltas_abort(over, needle):
+    d = classify_topology_delta(_topo(), _topo(**over))
+    assert d.kind == "abort", d
+    assert needle in d.reason  # the reason must be actionable
+
+
+def test_classify_tp_width_change_aborts_only_under_quant_state():
+    new = _topo(mesh={"data": 2, "spatial": 1, "time": 1, "model": 2,
+                      "pipe": 1})
+    # no amax state: the Megatron layout re-derives from rules — reshard
+    assert classify_topology_delta(_topo(), new).kind == "reshard"
+    # delayed-int8 amax state is calibrated per shard width — abort
+    d = classify_topology_delta(_topo(), new, has_quant_state=True)
+    assert d.kind == "abort" and "tensor-parallel" in d.reason
+
+
+def test_classify_missing_keys_are_forward_compatible():
+    # pre-elastic sidecars record nothing — every key absent must match
+    assert classify_topology_delta({}, _topo()).kind == "same"
+    # partial blocks compare only what they recorded
+    assert classify_topology_delta({"global_batch": 8}, _topo()).kind \
+        == "same"
+    assert classify_topology_delta({"global_batch": 2}, _topo()).kind \
+        == "abort"
+
+
+def test_mesh_topology_and_describe():
+    mesh = make_mesh(MeshSpec(data=2))
+    topo = mesh_topology(mesh)
+    assert topo["process_count"] == 1
+    assert topo["device_count"] == 2
+    assert topo["mesh"]["data"] == 2
+    topo["global_batch"] = 8
+    line = describe_topology(topo)
+    assert "data=2" in line and "global_batch=8" in line
+    # no mesh (single-device trainer): still a valid block
+    none_topo = mesh_topology(None)
+    assert none_topo["mesh"] == {}
+    assert none_topo["device_count"] == len(jax.devices())
+
+
+# ------------------------------------- resolve diagnostics (satellite 2)
+
+
+def test_resolve_indivisible_names_axes_and_counts():
+    with pytest.raises(ValueError) as ei:
+        MeshSpec(data=-1, spatial=3).resolve(8)
+    msg = str(ei.value)
+    assert "spatial*time*model*pipe=3" in msg
+    assert "8 device(s)" in msg
+
+
+def test_resolve_oversubscribed_names_requirement():
+    with pytest.raises(ValueError) as ei:
+        MeshSpec(data=16).resolve(8)
+    msg = str(ei.value)
+    assert "needs 16 devices" in msg and "only 8" in msg
+
+
+def test_resolve_failure_carries_relaunch_context():
+    ctx = "checkpoint was saved on 2 process(es) x 8 device(s)"
+    with pytest.raises(ValueError, match="2 process"):
+        MeshSpec(data=16).resolve(8, context=ctx)
+
+
+def test_build_trainer_mesh_enriches_with_saved_topology(tmp_path):
+    """A relaunch whose --mesh doesn't fit the new slice must name the
+    topology the checkpoint was saved on, not just the bare divisibility
+    error."""
+    from p2p_tpu.core.config import Config, DataConfig, ParallelConfig
+    from p2p_tpu.train.loop import build_trainer_mesh
+
+    cfg = Config(name="el", data=DataConfig(dataset="elsynth"),
+                 parallel=ParallelConfig(mesh=MeshSpec(data=1024)))
+    wd = str(tmp_path)
+    aux = os.path.join(wd, "checkpoint", "elsynth", "el.aux")
+    os.makedirs(aux)
+    with open(os.path.join(aux, "7.json"), "w") as f:
+        json.dump({"step": 7, "topology": {
+            "process_count": 2, "device_count": 1024,
+            "mesh": {"data": 1024}}}, f)
+    with pytest.raises(ValueError) as ei:
+        build_trainer_mesh(cfg, wd)
+    msg = str(ei.value)
+    assert "relaunch context" in msg and "1024 device(s)" in msg
+
+
+# ------------------------------------------- sidecar peek + degradation
+
+
+def test_peek_topology_newest_valid_sidecar_wins(tmp_path):
+    from p2p_tpu.train.checkpoint import peek_topology
+
+    d = str(tmp_path / "ck")
+    assert peek_topology(d) is None  # no aux dir at all
+    aux = d + ".aux"
+    os.makedirs(aux)
+    with open(os.path.join(aux, "3.json"), "w") as f:
+        json.dump({"step": 3, "topology": {"process_count": 2}}, f)
+    with open(os.path.join(aux, "5.json"), "w") as f:
+        f.write('{"step": 5, "topo')  # torn half-write: skipped
+    with open(os.path.join(aux, "4.json"), "w") as f:
+        json.dump({"step": 4}, f)  # pre-elastic: no topology block
+    with open(os.path.join(aux, "3.integrity.json"), "w") as f:
+        json.dump({"step": 3}, f)  # non-sidecar names are ignored
+    assert peek_topology(d) == {"process_count": 2}
+
+
+def test_restore_aux_corrupt_sidecar_degrades_to_missing(tmp_path, capsys):
+    """Satellite: a truncated sidecar (hard kill mid-write on a
+    non-atomic filesystem) must read as MISSING — counted on
+    ``aux_corrupt_total`` with a kind=aux_corrupt record — so resume
+    falls back to the step-derived position instead of dying on
+    JSONDecodeError."""
+    from p2p_tpu.obs import MetricsRegistry
+    from p2p_tpu.train.checkpoint import CheckpointManager
+
+    reg = MetricsRegistry()
+    cm = CheckpointManager(str(tmp_path / "ck"), registry=reg)
+    try:
+        cm.save_aux(7, {"step": 7, "batches_done": 3})
+        assert cm.restore_aux(7) == {"step": 7, "batches_done": 3}
+        # truncate it mid-token, as a kill mid-write would
+        with open(os.path.join(str(tmp_path / "ck") + ".aux",
+                               "7.json"), "w") as f:
+            f.write('{"step": 7, "batches_don')
+        assert cm.restore_aux(7) is None
+        assert reg.counter("aux_corrupt_total").value == 1
+        assert "treating as missing" in capsys.readouterr().out
+        # absent stays silently-None (no corruption counted)
+        assert cm.restore_aux(99) is None
+        assert reg.counter("aux_corrupt_total").value == 1
+    finally:
+        cm.close()
+
+
+# ------------------------------------------ rule-driven target shardings
+
+
+def test_leaf_path_name_joins_keys():
+    from p2p_tpu.parallel.rules import leaf_path_name
+
+    tree = {"params_g": {"down1": {"kernel": np.zeros((2, 2))}}}
+    paths = []
+    jax.tree_util.tree_map_with_path(
+        lambda p, _: paths.append(leaf_path_name(p)), tree)
+    assert paths == ["params_g/down1/kernel"]
+
+
+def test_match_partition_rules_first_match_and_scalar_floor():
+    from p2p_tpu.parallel.rules import match_partition_rules
+
+    tree = {
+        "params": {"conv": {"kernel": np.zeros((3, 3, 4, 8)),
+                            "bias": np.zeros((8,))}},
+        "step": np.zeros(()),          # scalar: never partitioned
+        "lr_scale": np.zeros((1,)),    # 1-element: never partitioned
+    }
+    rules = ((r"kernel$", P(None, None, None, "model")), (r".*", P()))
+    specs = match_partition_rules(rules, tree)
+    assert specs["params"]["conv"]["kernel"] == P(None, None, None, "model")
+    assert specs["params"]["conv"]["bias"] == P()
+    assert specs["step"] == P()
+    assert specs["lr_scale"] == P()
+
+
+def test_match_partition_rules_unmatched_leaf_raises():
+    from p2p_tpu.parallel.rules import match_partition_rules
+
+    with pytest.raises(ValueError, match="no partition rule matched"):
+        match_partition_rules(((r"kernel$", P()),),
+                              {"bias": np.zeros((4,))})
+
+
+def test_state_target_shardings_replicates_by_default():
+    from jax.sharding import NamedSharding
+
+    from p2p_tpu.parallel.rules import state_target_shardings
+
+    mesh = make_mesh(MeshSpec(data=2))
+    tree = {"w": np.zeros((4, 4)), "step": np.zeros(())}
+    sh = state_target_shardings(tree, mesh)
+    assert isinstance(sh["w"], NamedSharding)
+    assert sh["w"].spec == P() and sh["w"].mesh.shape["data"] == 2
+
+
+# ----------------------------------------- the cross-topology resume pin
+
+
+def _elastic_cfg(data_axis: int, batch: int = 4, elastic: bool = True):
+    from p2p_tpu.core.config import (
+        Config, DataConfig, LossConfig, ModelConfig, OptimConfig,
+        ParallelConfig, TrainConfig,
+    )
+
+    return Config(
+        name="elastic",
+        model=ModelConfig(generator="unet", ngf=4, ndf=4, num_D=1,
+                          n_layers_D=2, use_spectral_norm=False,
+                          use_compression_net=False, use_dropout=True),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        optim=OptimConfig(niter=2, niter_decay=2),
+        data=DataConfig(batch_size=batch, image_size=16, threads=0),
+        parallel=ParallelConfig(mesh=MeshSpec(data=data_axis)),
+        train=TrainConfig(nepoch=2, epoch_save=2, log_every=100,
+                          mixed_precision=False, seed=0,
+                          eval_every_epoch=False, elastic=elastic),
+    )
+
+
+class _StopAfter:
+    """Deterministic stand-in guard: 'preempt' at an exact step boundary."""
+
+    def __init__(self, n_steps):
+        self.calls = 0
+        self.n = n_steps
+        self.signum = signal.SIGTERM
+
+    def should_stop(self):
+        self.calls += 1
+        return self.calls >= self.n
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+@pytest.fixture()
+def _preempted_run(tmp_path, monkeypatch):
+    """A data=2 run preempted at step 3 (mid-epoch-2); returns (root, wd)."""
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.resilience import Preempted
+    from p2p_tpu.train.loop import Trainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "data"), 8, 2, size=16)
+    wd = str(tmp_path / "w")
+    tr = Trainer(_elastic_cfg(2), data_root=root, workdir=wd)
+    tr.preempt = _StopAfter(3)
+    try:
+        with pytest.raises(Preempted) as pi:
+            tr.fit()
+    finally:
+        tr.close()
+    assert pi.value.step == 3
+    aux = tr.ckpt.restore_aux(3)
+    assert aux["topology"]["mesh"]["data"] == 2
+    assert aux["topology"]["global_batch"] == 4
+    return root, wd
+
+
+def test_cross_mesh_resume_bitwise_equals_same_topology(
+        _preempted_run, tmp_path):
+    """THE elastic pin: the step-3 checkpoint written on a data=2 mesh,
+    restored onto a data=4 mesh (reshard delta), is BITWISE-equal to the
+    same-topology restore — same weights, same optimizer moments, same
+    resume position — and the reshard is auditable."""
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+
+    # same-topology control restore
+    trc = Trainer(_elastic_cfg(2), data_root=root, workdir=wd)
+    assert trc.maybe_resume()
+    assert trc.obs.counter("resharded_restore_total").value == 0
+    state_c = jax.device_get(trc.state)
+    pos_c = (trc.epoch, trc._resume_skip)
+    trc.close()
+
+    # cross-topology restore: data 2 → 4 classifies as a reshard
+    trb = Trainer(_elastic_cfg(4), data_root=root, workdir=wd)
+    assert trb.maybe_resume()
+    assert trb.obs.counter("resharded_restore_total").value == 1
+    assert trb.obs.counter("elastic_resume_total").value == 1
+    state_b = jax.device_get(trb.state)
+    assert (trb.epoch, trb._resume_skip) == pos_c == (2, 1)
+
+    leaves_b, td_b = jax.tree_util.tree_flatten(state_b)
+    leaves_c, td_c = jax.tree_util.tree_flatten(state_c)
+    assert td_b == td_c
+    for i, (b, c) in enumerate(zip(leaves_b, leaves_c)):
+        assert np.array_equal(np.asarray(b), np.asarray(c)), (
+            f"leaf {i} differs between cross- and same-topology restore")
+
+    # the resumed run completes on the NEW mesh
+    try:
+        trb.fit()
+    finally:
+        trb.close()
+    assert int(np.asarray(jax.device_get(trb.state.step))) == 4
+
+    recs = _records(os.path.join(wd, "metrics_elastic.jsonl"))
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[0]["decision"] == "reshard"
+    assert el[0]["saved"]["mesh"]["data"] == 2
+    assert el[0]["current"]["mesh"]["data"] == 4
+    rs = [r for r in recs if r.get("kind") == "resharded_restore"]
+    assert rs and rs[0]["resharded_restore_total"] >= 1
+
+
+def test_no_elastic_flag_restores_strict_contract(_preempted_run):
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+    tr = Trainer(_elastic_cfg(4, elastic=False), data_root=root, workdir=wd)
+    try:
+        with pytest.raises(TopologyMismatch, match="--no-elastic"):
+            tr.maybe_resume()
+    finally:
+        tr.close()
+
+
+def test_global_batch_delta_aborts_resume(_preempted_run):
+    """Sample accounting cannot survive a batch-size change — the abort
+    must name both topologies and the fix."""
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+    tr = Trainer(_elastic_cfg(2, batch=2), data_root=root, workdir=wd)
+    try:
+        with pytest.raises(TopologyMismatch) as ei:
+            tr.maybe_resume()
+    finally:
+        tr.close()
+    msg = str(ei.value)
+    assert "--batch_size" in msg
+    assert "saved:" in msg and "current:" in msg
+
+
+def _aux_path(wd, step=3):
+    return os.path.join(wd, "checkpoint", "facades", "elastic.aux",
+                        f"{step}.json")
+
+
+def test_grain_loader_mid_epoch_reshard_aborts(_preempted_run):
+    """The gapless mid-epoch guarantee is the FALLBACK loader's stride
+    arithmetic; Grain shards contiguous record blocks per process, so a
+    checkpoint whose sidecar records loader=grain must refuse a mid-epoch
+    reshard instead of silently drifting."""
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+    p = _aux_path(wd)
+    with open(p) as f:
+        aux = json.load(f)
+    aux["topology"]["loader"] = "grain"
+    with open(p, "w") as f:
+        json.dump(aux, f)
+    tr = Trainer(_elastic_cfg(4), data_root=root, workdir=wd)
+    try:
+        with pytest.raises(TopologyMismatch, match="P2P_TPU_NO_GRAIN"):
+            tr.maybe_resume()
+    finally:
+        tr.close()
+
+
+def test_torn_sidecar_still_reconciles_via_older_sidecar(_preempted_run):
+    """A half-written sidecar for the restored step must NOT bypass the
+    must-abort classification: the newest intact sidecar still names the
+    run's topology. Also pins single-counting: the torn file bumps
+    aux_corrupt_total exactly once across the whole resume."""
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+    # an older intact sidecar recording an INCOMPATIBLE global batch
+    with open(_aux_path(wd, 2), "w") as f:
+        json.dump({"step": 2, "topology": {"global_batch": 8}}, f)
+    # tear the restored step's sidecar mid-token
+    with open(_aux_path(wd, 3), "w") as f:
+        f.write('{"step": 3, "topolo')
+    tr = Trainer(_elastic_cfg(4), data_root=root, workdir=wd)
+    try:
+        with pytest.raises(TopologyMismatch, match="--batch_size"):
+            tr.maybe_resume()
+        assert tr.obs.counter("aux_corrupt_total").value == 1
+    finally:
+        tr.close()
+
+
+def test_loader_kind_honors_no_grain_env(monkeypatch):
+    from p2p_tpu.data.pipeline import loader_kind
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    assert loader_kind() == "fallback"
+    monkeypatch.delenv("P2P_TPU_NO_GRAIN")
+    try:
+        import grain.python  # noqa: F401
+        want = "grain"
+    except Exception:
+        want = "fallback"
+    assert loader_kind() == want
+
+
+def test_cli_elastic_flag_roundtrip():
+    from p2p_tpu.cli.train import build_parser, config_from_flags
+
+    assert config_from_flags(
+        build_parser().parse_args([])).train.elastic is True
+    assert config_from_flags(
+        build_parser().parse_args(["--no-elastic"])).train.elastic is False

@@ -241,3 +241,129 @@ def test_sigterm_mid_epoch_exact_resume(tmp_path):
         f"{steps[max(0, saved_step - 3):saved_step + 3]}")
     epochs = [r for r in recs if r.get("kind") == "epoch"]
     assert len(epochs) == 1 and int(epochs[0]["epoch"]) == 1
+
+
+def _all_train_steps(wd, name):
+    """Union of per-step records across every process's metrics file
+    (proc 0 writes metrics_<name>.jsonl, proc N a metrics_<name>.pN.jsonl
+    sibling — train/loop.py metrics_path)."""
+    out, seen = [], []
+    for fn in sorted(os.listdir(wd)):
+        if fn == f"metrics_{name}.jsonl" or (
+                fn.startswith(f"metrics_{name}.p")
+                and fn.endswith(".jsonl")):
+            seen.append(fn)
+            out.extend(_train_steps(os.path.join(wd, fn)))
+    assert len(seen) >= 1, f"no metrics files for {name} in {wd}"
+    return out
+
+
+@pytest.mark.slow
+def test_elastic_kill_resume_across_process_count_and_mesh(tmp_path):
+    """THE elastic acceptance pin, end-to-end over real processes: a
+    2-process (4-device, data=4) CLI run is preempted mid-epoch by the
+    ``elastic`` chaos seam (deterministic synthetic SIGTERM at host step
+    3, cross-host agreed) and exits 75 on both processes; the relaunch is
+    SINGLE-process on a data=2 mesh — a different process count, device
+    count, and data-axis width — against the same workdir. It must
+    reconcile the sidecar's recorded topology, reshard the restore, and
+    finish with GAPLESS per-sample accounting: the union of both phases'
+    per-step records is exactly 1..steps_per_epoch, nothing replayed,
+    nothing skipped."""
+    import socket
+
+    from p2p_tpu.resilience import PREEMPTED_EXIT_CODE
+
+    n_train = 24          # bs 4 → 6 steps/epoch; kill at step 3
+    root = make_synthetic_dataset(str(tmp_path / "data"), n_train, 2, size=16)
+    wd = str(tmp_path / "w")
+    os.makedirs(wd)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    args = [
+        "--preset", "facades", "--data_root", root, "--workdir", wd,
+        "--name", "el", "--dataset", "elsynth",
+        "--image_size", "16", "--batch_size", "4", "--test_batch_size", "2",
+        "--ngf", "4", "--ndf", "4", "--threads", "0",
+        "--nepoch", "1", "--niter", "1", "--niter_decay", "0",
+        "--epochsave", "1", "--seed", "0", "--lambda_vgg", "0",
+        "--log_every", "1",
+    ]
+
+    # ---- phase A: 2 processes x 2 local devices = data=4 mesh, killed
+    # mid-epoch at host step 3 by the elastic chaos seam
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["P2P_TPU_NO_GRAIN"] = "1"          # fallback-loader accounting pin
+    env["P2P_CHAOS"] = "elastic@3"         # deterministic mid-epoch preempt
+    worker = os.path.join(os.path.dirname(__file__), "mp_elastic_worker.py")
+    procs, logs = [], []
+    for pid in range(2):
+        log_path = str(tmp_path / f"elastic_worker_{pid}.log")
+        logs.append(log_path)
+        lf = open(log_path, "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port),
+             *args, "--mesh=-1,1,1"],
+            env=env, stdout=lf, stderr=subprocess.STDOUT, cwd=repo,
+        ))
+    rcs = [p.wait(timeout=540) for p in procs]
+    for pid, rc in enumerate(rcs):
+        if rc != PREEMPTED_EXIT_CODE:
+            with open(logs[pid]) as f:
+                pytest.fail(f"phase-A worker {pid} exited {rc} "
+                            f"(want 75):\n{f.read()[-4000:]}")
+    with open(logs[0]) as f:
+        assert "preempted: checkpoint saved at step 3" in f.read()
+
+    ckpt_dir = os.path.join(wd, "checkpoint", "elsynth", "el")
+    assert os.path.isdir(os.path.join(ckpt_dir, "3"))
+    with open(os.path.join(ckpt_dir + ".aux", "3.json")) as f:
+        topo = json.load(f)["topology"]
+    assert topo["process_count"] == 2 and topo["mesh"]["data"] == 4
+    # BOTH processes' accounting evidence must exist (proc 1 writes the
+    # .p1 sibling) and agree on the same gapless prefix
+    assert os.path.exists(os.path.join(wd, "metrics_el.p1.jsonl"))
+    steps_a = _all_train_steps(wd, "el")
+    assert sorted(set(steps_a)) == [1, 2, 3]
+
+    # ---- phase B: SINGLE process, data=2 mesh (different process count,
+    # device count, and data width) — must reshard-resume and finish
+    env_b = dict(env)
+    env_b.pop("P2P_CHAOS", None)
+    out2 = subprocess.run(
+        [sys.executable, "-c", _SHIM, *args, "--mesh", "2,1,1"],
+        env=env_b, capture_output=True, text=True, timeout=540, cwd=repo,
+    )
+    assert out2.returncode == 0, out2.stdout[-3000:] + out2.stderr[-2000:]
+    assert "resumed at epoch" in out2.stdout
+    assert "elastic resume" in out2.stdout
+
+    recs = [json.loads(line)
+            for line in open(os.path.join(wd, "metrics_el.jsonl"))]
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[0]["decision"] == "reshard"
+    assert el[0]["saved"]["process_count"] == 2
+    assert el[0]["current"]["process_count"] == 1
+    rs = [r for r in recs if r.get("kind") == "resharded_restore"]
+    assert rs and rs[0]["resharded_restore_total"] >= 1
+    resume = [r for r in recs if r.get("kind") == "resume"]
+    assert resume and int(resume[0]["batches_done"]) == 3
+
+    # gapless per-sample accounting across the topology change: the union
+    # of phase A's (per-process) and phase B's step records is exactly
+    # 1..6, each once — the relaunch's hosts landed on the correct shard
+    # offsets, zero duplicated, zero dropped
+    steps = sorted(set(_all_train_steps(wd, "el")))
+    spe = n_train // 4
+    assert steps == list(range(1, spe + 1)), (
+        f"step sequence has gaps/repeats across the elastic relaunch: "
+        f"{steps}")
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    assert len(epochs) == 1 and int(epochs[0]["epoch"]) == 1

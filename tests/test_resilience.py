@@ -609,6 +609,10 @@ def test_preempt_writes_sidecar_and_metrics_record(tmp_path, monkeypatch):
     ck = CheckpointManager(os.path.join(wd, "checkpoint", "facades", "exact"))
     aux = ck.restore_aux(3)
     ck.close()
+    # the topology block rides the same sidecar (elastic relaunch) —
+    # asserted by shape here, in full by tests/test_elastic.py
+    topo = aux.pop("topology")
+    assert topo["process_count"] == 1 and topo["global_batch"] == 2
     assert aux == {"step": 3, "epoch": 1, "batches_done": 3,
                    "steps_per_epoch": 4, "aug_seed": 1,
                    "seed_jitter": 0, "lr_base": 1.0}
