@@ -21,6 +21,7 @@ Subpackages:
     train     train state, jitted step, schedules, checkpointing, loop
     parallel  sharding rules, halo exchange, collectives
     infer     batched generator inference
+    analysis  static analysis: sharding audit, jaxpr/HLO lint, AST rules
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,270 @@
+"""Project AST lints — the traps this repo has already been bitten by.
+
+Four rules, each scoped to the zone of ``p2p_tpu/`` where the trap is
+real (a blanket rule would drown the signal — host-side data/chaos code
+legitimately uses ``np.random``):
+
+- ``ast-traced-randomness`` (error, traced zone: models/ ops/ losses/
+  parallel/ train/step.py train/video_step.py): ``np.random.*`` /
+  ``random.*`` calls in modules whose code runs under ``jit``. Python
+  randomness inside a traced fn bakes ONE sample into the compiled
+  program — the classic silent-determinism bug; use ``jax.random`` with a
+  threaded key.
+- ``ast-debug-outside-obs`` (error, everywhere except obs/):
+  ``jax.debug.*`` belongs behind the p2p_tpu/obs seams (taps.py's
+  sentinel, spans) where cost and cadence are managed; a stray
+  ``jax.debug.print`` in a model fences every dispatch.
+- ``ast-host-sync-hot-loop`` (warning, hot loop zone: train/loop.py
+  train/video_loop.py serve/engine.py): ``.item()`` /
+  ``jax.device_get(...)`` force a device→host sync at the call site; the
+  loop's contract is delayed, batched reads (queue_health_observation,
+  AsyncImageWriter's batched D2H).
+- ``ast-cli-flag-drift`` (error, cli/): (a) an ``add_argument`` flag whose
+  ``args.<dest>`` is never read — parsed-but-dead surface area; (b) an
+  ``apply_overrides``/``over`` keyword that names no field on any
+  core.config dataclass — the flag would raise (or worse, silently stop
+  applying) after a config refactor.
+
+Findings are waivable in-source: ``# p2p-lint: disable=<rule> -- reason``
+on the line or the line above (p2p_tpu/analysis/findings.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set
+
+from p2p_tpu.analysis.findings import (
+    ERROR,
+    WARNING,
+    Finding,
+    Report,
+    apply_pragma_waivers,
+)
+
+RULE_RANDOMNESS = "ast-traced-randomness"
+RULE_DEBUG = "ast-debug-outside-obs"
+RULE_HOST_SYNC = "ast-host-sync-hot-loop"
+RULE_FLAG_DRIFT = "ast-cli-flag-drift"
+
+#: module zones (package-relative, '/'-separated)
+TRACED_ZONE = ("models/", "ops/", "losses/", "parallel/")
+TRACED_FILES = ("train/step.py", "train/video_step.py")
+HOT_LOOP_FILES = ("train/loop.py", "train/video_loop.py", "serve/engine.py")
+OBS_ZONE = ("obs/",)
+CLI_ZONE = ("cli/",)
+
+_HOST_SYNC_CALLS = {"jax.device_get"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _in_zone(relpath: str, dirs: Sequence[str] = (),
+             files: Sequence[str] = ()) -> bool:
+    return relpath in files or any(relpath.startswith(d) for d in dirs)
+
+
+def config_field_names() -> Set[str]:
+    """Union of field names over every dataclass in core.config (plus
+    MeshSpec) — the legal keyword surface of ``apply_overrides``."""
+    import dataclasses
+
+    from p2p_tpu.core import config as config_mod
+    from p2p_tpu.core.mesh import MeshSpec
+
+    names: Set[str] = set()
+    for obj in list(vars(config_mod).values()) + [MeshSpec]:
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            names.update(f.name for f in dataclasses.fields(obj))
+    return names
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, imports_random: bool):
+        self.relpath = relpath
+        self.imports_random = imports_random
+        self.findings: List[Finding] = []
+        # cli-flag-drift accounting
+        self.arg_defs: Dict[str, int] = {}      # dest -> line
+        self.attr_reads: Set[str] = set()       # args.<x>
+        self.str_consts: Set[str] = set()       # any string constant
+        self.over_kwargs: List = []             # (kwarg, line)
+
+    # ---- generic collection -------------------------------------------
+    def visit_Constant(self, node: ast.Constant):
+        if isinstance(node.value, str):
+            self.str_consts.add(node.value)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "args" \
+                and isinstance(node.ctx, ast.Load):
+            self.attr_reads.add(node.attr)
+        self.generic_visit(node)
+
+    # ---- the rules -----------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        if dotted:
+            self._check_randomness(node, dotted)
+            self._check_debug(node, dotted)
+            self._check_host_sync(node, dotted)
+        self._collect_cli(node, dotted)
+        self.generic_visit(node)
+
+    def _check_randomness(self, node, dotted: str):
+        if not _in_zone(self.relpath, TRACED_ZONE, TRACED_FILES):
+            return
+        hit = (dotted.startswith("np.random.")
+               or dotted.startswith("numpy.random.")
+               or (self.imports_random and dotted.startswith("random.")))
+        if hit:
+            self.findings.append(Finding(
+                rule=RULE_RANDOMNESS, severity=ERROR,
+                file=self.relpath, line=node.lineno,
+                message=f"{dotted}() in a traced module: Python/numpy "
+                        "randomness bakes one sample into the compiled "
+                        "program — thread a jax.random key instead",
+            ))
+
+    def _check_debug(self, node, dotted: str):
+        if _in_zone(self.relpath, OBS_ZONE):
+            return
+        if dotted.startswith("jax.debug."):
+            self.findings.append(Finding(
+                rule=RULE_DEBUG, severity=ERROR,
+                file=self.relpath, line=node.lineno,
+                message=f"{dotted}() outside the p2p_tpu/obs seams — "
+                        "telemetry/debug taps route through obs (taps.py, "
+                        "spans.py) where cost and cadence are managed",
+            ))
+
+    def _check_host_sync(self, node, dotted: str):
+        if not _in_zone(self.relpath, files=HOT_LOOP_FILES):
+            return
+        is_item = (isinstance(node.func, ast.Attribute)
+                   and node.func.attr == "item" and not node.args
+                   and not node.keywords)
+        if is_item or dotted in _HOST_SYNC_CALLS:
+            what = dotted if dotted in _HOST_SYNC_CALLS else ".item()"
+            self.findings.append(Finding(
+                rule=RULE_HOST_SYNC, severity=WARNING,
+                file=self.relpath, line=node.lineno,
+                message=f"{what} in a hot loop forces a device→host sync "
+                        "at the call site — batch/delay the read "
+                        "(queue_health_observation, AsyncImageWriter)",
+            ))
+
+    def _collect_cli(self, node: ast.Call, dotted: Optional[str]):
+        if not _in_zone(self.relpath, CLI_ZONE):
+            return
+        func = node.func
+        # X.add_argument("--flag", ...) — any receiver
+        if isinstance(func, ast.Attribute) and func.attr == "add_argument" \
+                and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+                    and first.value.startswith("-"):
+                dest = first.value.lstrip("-").replace("-", "_")
+                for kw in node.keywords:
+                    if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                        dest = str(kw.value.value)
+                self.arg_defs[dest] = node.lineno
+        # getattr(args, "name"[, default]) counts as a read
+        if isinstance(func, ast.Name) and func.id == "getattr" and node.args:
+            recv = node.args[0]
+            if isinstance(recv, ast.Name) and recv.id == "args" \
+                    and len(node.args) > 1 \
+                    and isinstance(node.args[1], ast.Constant):
+                self.attr_reads.add(str(node.args[1].value))
+        # over(cfg_block, field=...) / apply_overrides(...)
+        name = dotted or ""
+        if name in ("over", "apply_overrides") \
+                or name.endswith(".apply_overrides"):
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    self.over_kwargs.append((kw.arg, node.lineno))
+
+    def finish(self) -> List[Finding]:
+        if _in_zone(self.relpath, CLI_ZONE):
+            referenced = self.attr_reads | self.str_consts
+            for dest, line in sorted(self.arg_defs.items()):
+                if dest not in referenced:
+                    self.findings.append(Finding(
+                        rule=RULE_FLAG_DRIFT, severity=ERROR,
+                        file=self.relpath, line=line,
+                        message=f"flag --{dest} is parsed but args.{dest} "
+                                "is never read — dead CLI surface (wire it "
+                                "or drop it)",
+                    ))
+            if self.over_kwargs:
+                try:
+                    fields = config_field_names()
+                except Exception:
+                    fields = set()   # config unimportable: skip, don't lie
+                for kwarg, line in self.over_kwargs:
+                    if fields and kwarg not in fields:
+                        self.findings.append(Finding(
+                            rule=RULE_FLAG_DRIFT, severity=ERROR,
+                            file=self.relpath, line=line,
+                            message=f"apply_overrides keyword {kwarg!r} "
+                                    "names no field on any core.config "
+                                    "dataclass — cfg↔flag drift",
+                        ))
+        return self.findings
+
+
+def lint_source(relpath: str, text: str) -> List[Finding]:
+    """All findings for one module, pragmas applied. ``relpath`` is the
+    package-relative path ('/'-separated, e.g. ``train/step.py``)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding(rule="ast-syntax-error", severity=ERROR,
+                        file=relpath, line=e.lineno or 1,
+                        message=f"unparseable module: {e.msg}")]
+    imports_random = any(
+        (isinstance(n, ast.Import)
+         and any(a.name == "random" for a in n.names))
+        for n in ast.walk(tree))
+    v = _Visitor(relpath, imports_random)
+    v.visit(tree)
+    return apply_pragma_waivers(v.finish(), sources={relpath: text})
+
+
+def lint_package(pkg_root: Optional[str] = None) -> Report:
+    """Run the AST pass over every module of ``p2p_tpu/`` (default: the
+    installed package directory). Findings keep package-relative paths;
+    pragma waivers are resolved against the real files."""
+    if pkg_root is None:
+        import p2p_tpu
+
+        pkg_root = os.path.dirname(os.path.abspath(p2p_tpu.__file__))
+    report = Report()
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, pkg_root).replace(os.sep, "/")
+            try:
+                with open(full, encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError as e:
+                report.add(Finding(rule="ast-unreadable", severity=ERROR,
+                                   file=rel, message=str(e)))
+                continue
+            report.extend(lint_source(rel, text))
+    return report

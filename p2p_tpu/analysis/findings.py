@@ -1,0 +1,199 @@
+"""Structured findings — the ONE report format every analyzer emits.
+
+A finding is ``(rule id, severity, location, message)``; locations are
+either ``file:line`` (AST rules, jaxpr eqn source info) or a tree leaf
+path (sharding audit). Any finding with a ``file:line`` location is
+waivable in-source with the pragma
+
+    # p2p-lint: disable=<rule>[,<rule>...] -- <reason>
+
+on the offending line or on the line directly above it. ``disable=all``
+waives every rule at that location. The ``-- <reason>`` tail is REQUIRED
+policy-wise (CI reports the waiver count; a waiver without a reason is
+itself a finding) — see docs/STATIC_ANALYSIS.md.
+
+Severity semantics:
+
+- ``error``   — a structural claim is violated now; fails the lint gate.
+- ``warning`` — latent hazard (e.g. a dead sharding rule); fails under
+  ``--strict`` (the CI mode).
+- ``info``    — informational, never fails. The sharding auditor's
+  ``tp``-diff migration worklist rides this level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+#: the in-source waiver pragma; reason tail after ``--`` is kept verbatim.
+PRAGMA_RE = re.compile(
+    r"#\s*p2p-lint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s+--\s*(.+?))?\s*$")
+
+RULE_BAD_WAIVER = "lint-waiver-without-reason"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    message: str
+    severity: str = ERROR
+    file: Optional[str] = None      # repo-relative or absolute path
+    line: Optional[int] = None      # 1-indexed
+    path: Optional[str] = None      # tree leaf path (sharding findings)
+    waived: bool = False
+    waive_reason: Optional[str] = None
+
+    @property
+    def location(self) -> str:
+        if self.file is not None:
+            return f"{self.file}:{self.line}" if self.line else self.file
+        return self.path or "<global>"
+
+    def format(self) -> str:
+        tail = f"  [waived: {self.waive_reason or 'no reason'}]" \
+            if self.waived else ""
+        return (f"{self.severity.upper():7s} {self.rule:28s} "
+                f"{self.location}: {self.message}{tail}")
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def parse_pragmas(text: str) -> Dict[int, Tuple[Set[str], str]]:
+    """1-indexed line → (waived rule ids, reason). ``all`` waives any rule."""
+    out: Dict[int, Tuple[Set[str], str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = PRAGMA_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[i] = (rules, (m.group(2) or "").strip())
+    return out
+
+
+def _pragma_for(pragmas: Dict[int, Tuple[Set[str], str]],
+                rule: str, line: int):
+    """A pragma waives the finding's own line or the line directly above."""
+    for ln in (line, line - 1):
+        hit = pragmas.get(ln)
+        if hit and (rule in hit[0] or "all" in hit[0]):
+            return hit
+    return None
+
+
+def apply_pragma_waivers(
+    findings: Sequence[Finding],
+    sources: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    """Mark file-located findings waived where a pragma covers them, and
+    APPEND a ``lint-waiver-without-reason`` finding for reasonless pragmas
+    that fired (a waiver must say why — docs/STATIC_ANALYSIS.md).
+
+    ``sources`` maps file path → text; missing entries are read from disk
+    (unreadable files simply leave the finding unwaived).
+    """
+    sources = dict(sources or {})
+    cache: Dict[str, Optional[Dict[int, Tuple[Set[str], str]]]] = {}
+    out = list(findings)
+    # bad-waiver findings collect SEPARATELY and append after the loop:
+    # appending mid-iteration would feed them back through the pragma
+    # match, where a reasonless `disable=all` waives the complaint about
+    # itself and spawns another, forever
+    bad: List[Finding] = []
+    seen_bad: Set[Tuple[str, int]] = set()
+    for f in out:
+        if f.file is None or f.line is None or f.waived:
+            continue
+        if f.file not in cache:
+            text = sources.get(f.file)
+            if text is None:
+                try:
+                    with open(f.file, encoding="utf-8") as fh:
+                        text = fh.read()
+                except OSError:
+                    text = None
+            cache[f.file] = parse_pragmas(text) if text is not None else None
+        pragmas = cache[f.file]
+        if not pragmas:
+            continue
+        hit = _pragma_for(pragmas, f.rule, f.line)
+        if hit is not None:
+            f.waived = True
+            f.waive_reason = hit[1] or None
+            if not hit[1] and (f.file, f.line) not in seen_bad:
+                seen_bad.add((f.file, f.line))
+                bad.append(Finding(
+                    rule=RULE_BAD_WAIVER, severity=WARNING,
+                    file=f.file, line=f.line,
+                    message=f"pragma waives {f.rule!r} without a "
+                            "'-- <reason>' tail",
+                ))
+    return out + bad
+
+
+class Report:
+    """An ordered finding collection with the gate semantics baked in."""
+
+    def __init__(self, findings: Sequence[Finding] = ()):
+        self.findings: List[Finding] = list(findings)
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings: Sequence[Finding]) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def waived(self) -> List[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    def failing(self, strict: bool = True) -> List[Finding]:
+        """Unwaived findings that fail the gate: errors always, warnings
+        under ``--strict``; info never fails."""
+        levels = (ERROR, WARNING) if strict else (ERROR,)
+        return [f for f in self.active if f.severity in levels]
+
+    def sorted(self) -> List[Finding]:
+        return sorted(
+            self.findings,
+            key=lambda f: (_SEVERITY_ORDER.get(f.severity, 9), f.rule,
+                           f.location),
+        )
+
+    def counts(self) -> Dict[str, int]:
+        c = {ERROR: 0, WARNING: 0, INFO: 0, "waived": 0}
+        for f in self.findings:
+            if f.waived:
+                c["waived"] += 1
+            else:
+                c[f.severity] = c.get(f.severity, 0) + 1
+        return c
+
+    def summary(self) -> str:
+        c = self.counts()
+        return (f"{c[ERROR]} errors, {c[WARNING]} warnings, {c[INFO]} info, "
+                f"{c['waived']} waived")
+
+    def render(self, include_info: bool = True) -> str:
+        lines = [f.format() for f in self.sorted()
+                 if include_info or f.severity != INFO or f.waived]
+        lines.append(self.summary())
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "findings": [f.as_dict() for f in self.sorted()],
+            "counts": self.counts(),
+        }, indent=2)

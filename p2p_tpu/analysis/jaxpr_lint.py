@@ -1,0 +1,270 @@
+"""jaxpr/HLO structural lint library — the reusable form of the test pins.
+
+tests/test_pp.py and tests/test_ops.py grew hand-rolled jaxpr walkers
+(``_sub_jaxprs``, the scan-carry ppermute check) and compiled-text
+all-gather greps; every new sharding/perf PR re-invented them. This module
+is the single source of truth those tests now import, plus the two checks
+the lint CLI runs as a standing gate:
+
+- **collective census** — :func:`collect_collectives` over a jaxpr (traced
+  primitive names, normalized: ``psum2`` → ``psum``) or compiled HLO text
+  (``all-gather``/``collective-permute``/... opcodes, async ``-start``
+  forms counted once), with :func:`assert_no_collective` /
+  :func:`assert_collective_count` as the pin forms.
+- **activation-gather bound** — :func:`assert_no_collective_as_large_as`:
+  no ``all-gather`` (or any chosen collective) operand/result shape on the
+  compiled text may reach the full-activation element count. This is the
+  exact check both HLO pins hand-rolled.
+- **scan-carry ppermute** — :func:`scan_ppermute_carry_flags`: for every
+  ``ppermute`` directly inside a ``lax.scan`` body, True iff its operand
+  is a scan CARRY invar (structurally independent of the tick's compute —
+  the latency-hiding schedule pin of docs/PARALLELISM.md).
+- **host-callback census** — :func:`host_callback_findings`: callbacks
+  (``pure_callback``/``io_callback``/``debug_callback``/``debug_print``)
+  inside a program that is supposed to be a hot path.
+- **f32-leak detector** — :func:`f32_leak_findings`: walks every
+  ``dot_general``/``conv_general_dilated`` eqn's operand dtypes under a
+  declared bf16 policy; an f32 operand is compute the policy says should
+  not exist. Findings carry the eqn's source ``file:line`` (via jax source
+  info), so deliberate f32 islands are waivable in-source with the
+  ``# p2p-lint: disable=...`` pragma.
+
+Everything here is trace/text-based: ``jax.make_jaxpr`` over
+``ShapeDtypeStruct`` args and ``.lower().compile().as_text()`` — zero
+device compute, CPU-safe (the CI contract).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Iterable, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from p2p_tpu.analysis.findings import ERROR, Finding
+
+RULE_HOST_CALLBACK = "jaxpr-host-callback"
+RULE_F32_LEAK = "jaxpr-f32-leak"
+
+#: traced collective primitives (normalized names — see normalize_primitive)
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "pmean", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pbroadcast", "pgather",
+})
+
+#: compiled-HLO collective opcodes (async forms appear as ``<op>-start``)
+HLO_COLLECTIVES = (
+    "all-gather", "all-reduce", "collective-permute", "all-to-all",
+    "reduce-scatter", "collective-broadcast",
+)
+
+# an HLO instruction is `%name = <shape> <opcode>(...)`; async collectives
+# carry TUPLE result shapes `(f32[..], f32[..])`, so the shape matcher must
+# accept both forms or -start lines silently drop out of the census
+_HLO_OP_RE = re.compile(
+    r"=\s+(?:\([^)]*\)|\S+)\s+(" + "|".join(HLO_COLLECTIVES)
+    + r")(-start)?\(")
+_HLO_SHAPE_RE = re.compile(r"\w+\[([\d,]+)\]")
+_CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "host_callback", "outside_call",
+})
+
+
+def normalize_primitive(name: str) -> str:
+    """Strip jax's versioning suffix from a primitive name (``psum2`` →
+    ``psum``) so call sites pin semantics, not jax-internal renames."""
+    return name.rstrip("0123456789")
+
+
+def sub_jaxprs(params) -> Iterator:
+    """Yield every (Closed)Jaxpr hiding in an eqn's params dict — the
+    recursion step shared by every structural walk (scan/cond/pjit/
+    shard_map/custom_vjp bodies)."""
+    for p in params.values():
+        vals = p if isinstance(p, (list, tuple)) else [p]
+        for q in vals:
+            if hasattr(q, "eqns"):
+                yield q
+            elif hasattr(q, "jaxpr") and hasattr(q.jaxpr, "eqns"):
+                yield q.jaxpr
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Depth-first over EVERY eqn of a jaxpr, descending into sub-jaxprs.
+    Accepts a Jaxpr or ClosedJaxpr."""
+    if hasattr(jaxpr, "jaxpr"):        # ClosedJaxpr
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def eqn_location(eqn) -> Tuple[Optional[str], Optional[int]]:
+    """(file, line) of the user frame that created an eqn, or (None, None).
+    Best-effort over jax's private source-info API — a jax upgrade that
+    moves it degrades findings to location-less, never crashes the lint."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return frame.file_name, int(frame.start_line)
+    except Exception:
+        pass
+    return None, None
+
+
+# ------------------------------------------------------------ collectives
+
+
+def collect_collectives(obj: Union[str, object]) -> Counter:
+    """Collective census of a jaxpr (traced primitive names) or compiled
+    HLO text (opcode names). Async HLO forms (``all-gather-start``) count
+    once under the base opcode; ``-done`` lines are not instructions that
+    move data and are ignored."""
+    if isinstance(obj, str):
+        counts: Counter = Counter()
+        for m in _HLO_OP_RE.finditer(obj):
+            counts[m.group(1)] += 1
+        return counts
+    return Counter(
+        normalize_primitive(e.primitive.name) for e in iter_eqns(obj)
+        if normalize_primitive(e.primitive.name) in COLLECTIVE_PRIMITIVES
+    )
+
+
+def assert_no_collective(obj, kinds: Optional[Iterable[str]] = None) -> None:
+    """Pin: the program contains NO collectives (or none of ``kinds``)."""
+    found = collect_collectives(obj)
+    if kinds is not None:
+        found = Counter({k: v for k, v in found.items() if k in set(kinds)})
+    assert not found, f"unexpected collectives in program: {dict(found)}"
+
+
+def assert_collective_count(obj, kind: str, expected: int) -> None:
+    """Pin: exactly ``expected`` instances of one collective kind."""
+    got = collect_collectives(obj)[kind]
+    assert got == expected, (
+        f"expected {expected} x {kind!r}, found {got} "
+        f"(census: {dict(collect_collectives(obj))})")
+
+
+def assert_collective_present(obj, kind: str) -> None:
+    """Pin: at least one instance of ``kind`` survives in the program
+    (e.g. the lowered ppermute was not optimized away on a fake mesh)."""
+    got = collect_collectives(obj)[kind]
+    assert got >= 1, (
+        f"no {kind!r} in program (census: {dict(collect_collectives(obj))})")
+
+
+def hlo_collective_shapes(text: str,
+                          kind: str = "all-gather") -> List[Tuple[int, str]]:
+    """Every (element count, line) for shapes on compiled-text lines that
+    mention ``kind``. Matches EVERY shape on the line — async forms carry
+    tuple shapes, and missing those would pass vacuously (the lesson both
+    hand-rolled greps encode)."""
+    out: List[Tuple[int, str]] = []
+    for ln in text.splitlines():
+        if kind not in ln:
+            continue
+        for m in _HLO_SHAPE_RE.finditer(ln):
+            dims = [int(d) for d in m.group(1).split(",") if d]
+            out.append((int(np.prod(dims)) if dims else 0, ln))
+    return out
+
+
+def assert_no_collective_as_large_as(text: str, numel: int,
+                                     kind: str = "all-gather") -> None:
+    """Pin: no ``kind`` line in the compiled text touches a shape with
+    >= ``numel`` elements — the "no full-activation all-gather" contract
+    (docs/PARALLELISM.md)."""
+    for n, ln in hlo_collective_shapes(text, kind):
+        assert n < numel, (
+            f"{kind} as large as the pinned bound ({n} >= {numel}): {ln}")
+
+
+# -------------------------------------------------- scan-carry ppermute
+
+
+def scan_ppermute_carry_flags(jaxpr) -> List[bool]:
+    """For every ``ppermute`` directly inside a ``lax.scan`` body: True iff
+    its operand is a scan CARRY invar (the transfer consumes the previous
+    tick's value and has no data dependence on this tick's compute — the
+    latency-hiding schedule's structural property)."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    out: List[bool] = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+                carry = set(map(id, body.invars[nc:nc + nk]))
+                for e2 in body.eqns:
+                    if normalize_primitive(e2.primitive.name) == "ppermute":
+                        out.append(id(e2.invars[0]) in carry)
+                walk(body)
+            else:
+                for sub in sub_jaxprs(eqn.params):
+                    walk(sub)
+
+    walk(jaxpr)
+    return out
+
+
+# ------------------------------------------------------- lint findings
+
+
+def host_callback_findings(jaxpr, tag: str = "program",
+                           allow: Iterable[str] = ()) -> List[Finding]:
+    """Findings for host callbacks inside a supposedly-hot program.
+
+    ``allow`` exempts primitive names (e.g. ``debug_callback`` when the
+    program deliberately carries an obs tap)."""
+    allowed = {normalize_primitive(a) for a in allow}
+    out: List[Finding] = []
+    for eqn in iter_eqns(jaxpr):
+        name = normalize_primitive(eqn.primitive.name)
+        if name in _CALLBACK_PRIMITIVES and name not in allowed:
+            fname, line = eqn_location(eqn)
+            out.append(Finding(
+                rule=RULE_HOST_CALLBACK, severity=ERROR,
+                file=fname, line=line, path=None if fname else tag,
+                message=f"host callback {name!r} in hot path {tag!r} — "
+                        "route telemetry through p2p_tpu/obs seams or keep "
+                        "it out of the jitted step",
+            ))
+    return out
+
+
+def f32_leak_findings(jaxpr, tag: str = "program",
+                      policy: str = "bfloat16") -> List[Finding]:
+    """Findings for ``dot_general``/``conv_general_dilated`` eqns with a
+    float32 operand under a declared low-precision compute policy.
+
+    The check is on OPERANDS (not outputs): f32 accumulation via
+    ``preferred_element_type`` is the policy-conformant pattern, an f32
+    input tensor is a leak — it forces the full-precision MXU path and
+    doubles the operand's HBM traffic."""
+    out: List[Finding] = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in ("dot_general", "conv_general_dilated"):
+            continue
+        dtypes = []
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            dtypes.append(str(getattr(aval, "dtype", "?")))
+        if any(d == "float32" for d in dtypes):
+            fname, line = eqn_location(eqn)
+            out.append(Finding(
+                rule=RULE_F32_LEAK, severity=ERROR,
+                file=fname, line=line, path=None if fname else tag,
+                message=f"{eqn.primitive.name} with float32 operand "
+                        f"{tuple(dtypes)} under declared {policy} policy "
+                        f"in {tag!r}",
+            ))
+    return out

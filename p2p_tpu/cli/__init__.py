@@ -9,6 +9,9 @@
   (directory-driven requests → bucket-batched predictions; docs/SERVING.md).
 - ``python -m p2p_tpu.cli.generate_dataset`` — offline paired-dataset
   generation (reference generate_dataset.py:150-165 flag parity).
+- ``python -m p2p_tpu.cli.lint`` — static-analysis gate over the repo
+  (p2p_tpu.analysis: sharding audit, jaxpr/HLO lint, AST rules;
+  docs/STATIC_ANALYSIS.md). ``--strict`` is the CI mode.
 """
 
 import dataclasses

@@ -34,6 +34,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "e.g. --crop_size 512 --crop_width 1024 for "
                         "pix2pixHD-shaped frames (TPU extension; the "
                         "reference datagen is square-only)")
+    # p2p-lint: disable=ast-cli-flag-drift -- reference-parity flag (generate_dataset.py:150-165), accepted but deliberately ignored: outputs are always png
     p.add_argument("--img_format", type=str, default="png",
                    help="accepted for parity; outputs are always png")
     p.add_argument("--min_std", type=float, default=0.0,

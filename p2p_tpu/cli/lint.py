@@ -1,0 +1,202 @@
+"""Static-analysis frontend — ``python -m p2p_tpu.cli.lint --strict``.
+
+The standing CI correctness gate (docs/STATIC_ANALYSIS.md). Runs the three
+:mod:`p2p_tpu.analysis` analyzers and fails on any unwaived finding:
+
+1. **AST rules** over every module of ``p2p_tpu/`` (traced randomness,
+   ``jax.debug`` outside obs, hot-loop host syncs, CLI↔config flag drift).
+2. **Sharding audit**: the declarative rule tables (parallel/rules.py)
+   statically verified against full-size preset TrainStates built
+   shape-only via ``jax.eval_shape`` — dead/shadowed rules, unknown mesh
+   axes, indivisible shards. The ``tp``-diff mode additionally reports
+   the leaves the regex table cannot yet express vs the hand-built TP
+   assignment: the ROADMAP item-3 migration worklist (info severity —
+   reported, never failing).
+3. **jaxpr lint**: the tiny-config eval forward and full GAN train step
+   traced with ``jax.make_jaxpr`` over ``ShapeDtypeStruct`` args (no
+   device compute) and walked for host callbacks and f32 dot/conv leaks
+   under the declared bf16 policy.
+
+Waivers: ``# p2p-lint: disable=<rule> -- reason`` in source (findings
+carry eqn source locations, so even jaxpr findings waive in-source); the
+waiver COUNT is printed in the summary — CI logs it on every run.
+
+Exit codes: 0 clean (waived-only), 1 unwaived findings, 2 analyzer crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import traceback
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="p2p_tpu static-analysis gate")
+    p.add_argument("--strict", action="store_true",
+                   help="fail on warnings too (the CI mode); default "
+                        "fails on errors only")
+    p.add_argument("--format", type=str, default="text",
+                   choices=["text", "json"],
+                   help="findings output format")
+    p.add_argument("--tp-diff", action="store_true", dest="tp_diff",
+                   help="also print the sharding auditor's tp-vs-rule-"
+                        "table migration worklist (ROADMAP item 3), one "
+                        "line per leaf")
+    p.add_argument("--skip-jaxpr", action="store_true",
+                   help="skip the (slower) traced-program lint — AST + "
+                        "sharding audit only")
+    p.add_argument("--tp-axis-size", type=int, default=2,
+                   help="hypothetical model-axis width for the tp diff")
+    p.add_argument("--tp-min-ch", type=int, default=512,
+                   help="TP pair-rule channel floor for the tp diff")
+    return p
+
+
+def _tiny_cfg():
+    """facades shrunk to trace-size: same code paths, seconds to trace."""
+    from p2p_tpu.core.config import get_preset
+
+    cfg = get_preset("facades")
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, ngf=8, ndf=8),
+        data=dataclasses.replace(cfg.data, image_size=16, batch_size=2),
+    )
+
+
+def _sds_tree(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def run_sharding_audit(report, tp_axis_size: int, tp_min_ch: int):
+    """Audit the repo's live rule tables against full-size preset states
+    (shape-only); returns the tp-diff worklist."""
+    from p2p_tpu.analysis.sharding_audit import (
+        abstract_train_state,
+        audit_rules,
+        tp_rule_gaps,
+    )
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.parallel.rules import REPLICATED_RULES
+
+    # the hypothetical target topology: every axis the mesh vocabulary
+    # names, sized so divisibility is actually exercised (no devices)
+    mesh = {"data": 8, "spatial": 2, "time": 1,
+            "model": tp_axis_size, "pipe": 2}
+    worklist = []
+    for preset in ("facades", "cityscapes_spatial"):
+        state = abstract_train_state(get_preset(preset))
+        report.extend(audit_rules(REPLICATED_RULES, state, mesh))
+        wl, findings = tp_rule_gaps(state, rules=REPLICATED_RULES,
+                                    axis_size=tp_axis_size,
+                                    min_ch=tp_min_ch)
+        for entry in wl:
+            entry["preset"] = preset
+        worklist.extend(wl)
+        report.extend(findings)
+    return worklist
+
+
+def run_jaxpr_lint(report):
+    """Trace the eval forward and the full GAN train step of the tiny
+    config (abstract args — zero device compute) and walk them for host
+    callbacks and f32 leaks under the declared bf16 policy."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.analysis.findings import apply_pragma_waivers
+    from p2p_tpu.analysis.jaxpr_lint import (
+        f32_leak_findings,
+        host_callback_findings,
+    )
+    from p2p_tpu.train.state import create_infer_state, create_train_state
+    from p2p_tpu.train.step import build_train_step, make_infer_forward
+
+    cfg = _tiny_cfg()
+    bs, (h, w) = cfg.data.batch_size, cfg.image_hw
+    sample = {"input": np.zeros((bs, h, w, cfg.model.input_nc), np.uint8),
+              "target": np.zeros((bs, h, w, cfg.model.output_nc), np.uint8)}
+    batch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in sample.items()}
+
+    findings = []
+    # eval/serving forward (metrics tail included — its f32 quality convs
+    # are the known, pragma-waived island in losses/metrics.py)
+    ist = jax.eval_shape(lambda: create_infer_state(
+        cfg, jax.random.key(0), sample, jnp.bfloat16))
+    jx = jax.make_jaxpr(make_infer_forward(cfg, jnp.bfloat16))(
+        _sds_tree(ist), batch)
+    findings += host_callback_findings(jx, tag="eval_forward")
+    findings += f32_leak_findings(jx, tag="eval_forward")
+
+    # the full alternating-GAN train step (debug taps at their defaults:
+    # a host callback here would fence every training dispatch)
+    ts = jax.eval_shape(lambda: create_train_state(
+        cfg, jax.random.key(0), sample, train_dtype=jnp.bfloat16))
+    jx = jax.make_jaxpr(build_train_step(cfg, train_dtype=jnp.bfloat16,
+                                         jit=False))(_sds_tree(ts), batch)
+    findings += host_callback_findings(jx, tag="train_step")
+    findings += f32_leak_findings(jx, tag="train_step")
+
+    report.extend(apply_pragma_waivers(findings))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from p2p_tpu.analysis.ast_rules import lint_package
+    from p2p_tpu.analysis.findings import Report
+
+    try:
+        report = lint_package()
+        worklist = run_sharding_audit(report, args.tp_axis_size,
+                                      args.tp_min_ch)
+        if not args.skip_jaxpr:
+            run_jaxpr_lint(report)
+    except Exception:
+        traceback.print_exc()
+        print("lint: analyzer crashed (exit 2)", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        import json
+
+        payload = json.loads(report.to_json())
+        if args.tp_diff:
+            # the machine-readable form of the item-3 worklist — the text
+            # branch's per-leaf lines, with shapes/specs as fields
+            payload["tp_worklist"] = worklist
+        print(json.dumps(payload, indent=2))
+    else:
+        print(report.render())
+        if args.tp_diff:
+            print(f"\ntp-diff migration worklist ({len(worklist)} leaves "
+                  "still need predicate rules — ROADMAP item 3):")
+            for entry in worklist:
+                print(f"  [{entry['preset']}] {entry['leaf']} "
+                      f"shape={entry['shape']} tp={entry['tp_spec']} "
+                      f"table={entry['rule_spec']} ({entry['direction']})")
+    failing = report.failing(strict=args.strict)
+    waived = len(report.waived)
+    mode = "strict" if args.strict else "default"
+    # json mode keeps stdout machine-parseable: the status line goes to
+    # stderr there, stdout in text mode (the CI log greps it)
+    status_stream = sys.stderr if args.format == "json" else sys.stdout
+    if failing:
+        print(f"lint: FAIL ({mode}) — {len(failing)} unwaived finding(s), "
+              f"{waived} waiver(s)", file=sys.stderr)
+        return 1
+    print(f"lint: OK ({mode}) — 0 unwaived findings, {waived} waiver(s) "
+          f"carried with reasons, tp worklist {len(worklist)} leaves",
+          file=status_stream)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -208,6 +208,7 @@ def kn2row_thin_conv(x: jax.Array, w: jax.Array, pad: int) -> jax.Array:
     # 4-D contraction over the channel dim (NO flattening reshape: a
     # (-1, C) reshape of e.g. a concat output forces XLA to materialize
     # layout copies of the big input — profiled +6 ms/step)
+    # p2p-lint: disable=jaxpr-f32-leak -- deliberate: z is f32 (MXU accumulation matching the XLA conv this replaces); its backward dots contract the f32 cotangent against the bf16 weight/input, which is the accumulation design, not a leak
     z = jax.lax.dot_general(
         x, wt.astype(x.dtype), (((3,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,  # f32 MXU accumulation

@@ -51,7 +51,12 @@ def leaf_path_name(path) -> str:
         elif hasattr(k, "idx"):
             parts.append(str(k.idx))
         else:
-            parts.append(str(k))
+            # pinned fallback for unknown key types (a future jax key kind
+            # must not silently change every rule-matchable path): the
+            # type name is part of the segment, so a rule written against
+            # the old ``str(k)`` form fails LOUDLY instead of matching a
+            # different leaf. Format pinned by tests/test_elastic.py.
+            parts.append(f"<{type(k).__name__}:{k}>")
     return "/".join(parts)
 
 
@@ -71,8 +76,11 @@ def match_partition_rules(rules: Rules, tree: Any):
         for rule, ps in rules:
             if re.search(rule, name) is not None:
                 return ps
+        tried = "; ".join(f"[{i}] {pat!r}" for i, (pat, _) in enumerate(rules))
         raise ValueError(f"no partition rule matched leaf {name!r} "
-                         f"(shape {tuple(shape)}) — add a catch-all rule")
+                         f"(shape {tuple(shape)}); tried "
+                         f"{tried or '<empty table>'} — add a catch-all "
+                         f"rule ('.*', P())")
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
 

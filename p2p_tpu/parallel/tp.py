@@ -151,6 +151,17 @@ def _tp_spec(path_str: str, shape, axis_size: int, min_ch: int):
     return P()
 
 
+def tp_leaf_spec(path_str: str, shape, axis_size: int,
+                 min_ch: int = 512) -> P:
+    """Pure-function view of the TP pair rule for ONE leaf: ``path_str``
+    is the ``jax.tree_util.keystr`` path, ``axis_size`` the (possibly
+    hypothetical) model-axis width. No mesh, no devices — this is what the
+    sharding auditor's ``tp``-diff mode (p2p_tpu/analysis/sharding_audit)
+    compares against a declarative rule table to emit the ROADMAP item-3
+    migration worklist."""
+    return _tp_spec(path_str, tuple(shape), axis_size, min_ch)
+
+
 def tp_sharding_tree(tree: Any, mesh: Mesh, min_ch: int = 512):
     """NamedSharding pytree for ``tree``: Megatron-style channel shards on
     ResnetBlock conv pairs wider than ``min_ch``, everything else

@@ -513,6 +513,7 @@ def consume_health_observation(tr, pend) -> None:
     from p2p_tpu.resilience.health import poison_nan_observation
 
     first_step, dev, k = pend
+    # p2p-lint: disable=ast-host-sync-hot-loop -- this IS the designed delayed read: the fetch lands ONE DISPATCH LATE (queue_health_observation), so the device is already past it
     host = jax.device_get(dev)
     for i in range(k):
         step = first_step + i
@@ -558,6 +559,7 @@ def perform_rollback(tr) -> None:
     tr.health.after_rollback(cur_step, int(target))
     # the restore overwrote lr_scale with the checkpoint's value — resync
     # the host cache so apply_health_lr compares against reality
+    # p2p-lint: disable=ast-host-sync-hot-loop -- rollback path only (rung 3 of the recovery ladder), never the per-step path
     tr._applied_lr_scale = float(np.asarray(jax.device_get(
         tr.state.lr_scale)))
     apply_health_lr(tr)  # post-rollback cooldown engages immediately
@@ -1190,6 +1192,7 @@ class Trainer:
         flush_health_observations(self)
         if sums is None:
             return {}
+        # p2p-lint: disable=ast-host-sync-hot-loop -- epoch boundary, once per epoch: the epoch record needs the sums and the fence doubles as the img/sec stop-clock
         host_sums = jax.device_get(sums)  # fences the epoch's last step
         elapsed = time.perf_counter() - t0 - compile_skew
         out = epoch_metric_means(host_sums, count)
@@ -1341,6 +1344,7 @@ class Trainer:
         self._preempted = False
         # host mirror of the device step counter (the health path must
         # never fetch state.step mid-epoch) — one scalar fetch per fit()
+        # p2p-lint: disable=ast-host-sync-hot-loop -- one scalar fetch per fit(), before the loop starts
         self._host_step = int(np.asarray(jax.device_get(self.state.step)))
         owned_guard = acquire_preempt_guard(self)
         try:

@@ -350,6 +350,7 @@ class VideoTrainer:
         flush_health_observations(self)
         if sums is None:
             return {}
+        # p2p-lint: disable=ast-host-sync-hot-loop -- epoch boundary, once per epoch (the image Trainer's twin)
         host = jax.device_get(sums)
         elapsed = time.perf_counter() - t0
         out = epoch_metric_means(host, count)
@@ -435,6 +436,7 @@ class VideoTrainer:
         # image Trainer: flag at the signal, exact-step save + Preempted
         # at the next step boundary, exact-step resume via maybe_resume's
         # skip_batches path.
+        # p2p-lint: disable=ast-host-sync-hot-loop -- one scalar fetch per fit(), before the loop starts
         self._host_step = int(np.asarray(jax.device_get(self.state.step)))
         owned_guard = acquire_preempt_guard(self)
         try:

@@ -430,11 +430,9 @@ def test_sharded_pallas_instance_norm_no_activation_allgather(devices8):
     no all-gather of the (N,H,W,C) activation may surround it (GSPMD's
     default for un-partitioned custom calls) — only the (N,1,1,C) stat
     psums cross devices."""
-    import re
-
-    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from p2p_tpu.analysis.jaxpr_lint import assert_no_collective_as_large_as
     from p2p_tpu.core.mesh import MeshSpec, make_mesh, mesh_context
     from p2p_tpu.ops.pallas.instance_norm import pallas_instance_norm
 
@@ -450,15 +448,10 @@ def test_sharded_pallas_instance_norm_no_activation_allgather(devices8):
     hlo = jax.jit(fn).lower(xs).compile().as_text()
     # local shard is (1, 8, 8, 6) = 384 elements; any all-gather touching
     # >= the full activation element count means the shard was gathered.
-    # Match EVERY shape on any all-gather / all-gather-start line (async
-    # forms carry tuple shapes — missing those would pass vacuously).
-    full = n * h * w * c
-    ag_lines = [ln for ln in hlo.splitlines() if "all-gather" in ln]
-    for ln in ag_lines:
-        for m in re.finditer(r"\w+\[([\d,]+)\]", ln):
-            dims = [int(d) for d in m.group(1).split(",") if d]
-            numel = int(np.prod(dims)) if dims else 0
-            assert numel < full, f"activation-sized all-gather in HLO: {ln}"
+    # The library check matches EVERY shape on any all-gather /
+    # all-gather-start line (async forms carry tuple shapes — missing
+    # those would pass vacuously).
+    assert_no_collective_as_large_as(hlo, n * h * w * c)
 
 
 def test_angular_loss_gradient_finite_on_zero_vectors():

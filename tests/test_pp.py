@@ -156,9 +156,9 @@ def test_pp_no_full_activation_allgather(devices8):
     tensor as large as the full activation — the data-sharded mb axis
     stays outermost through flat/unflat, so the encoder/decoder stay
     data-parallel. Mirrors the spatial pin at tests/test_ops.py."""
-    import re
-
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from p2p_tpu.analysis.jaxpr_lint import assert_no_collective_as_large_as
 
     mcfg, _, v, x = _setup(norm="batch", n_blocks=4)
     mesh = make_mesh(MeshSpec(data=2, pipe=2), devices=devices8[:4])
@@ -172,45 +172,14 @@ def test_pp_no_full_activation_allgather(devices8):
             v, stacked, x_mb).compile().as_text()
     # full activation: 8 images x 32 x 32 x 3 (encoder widths only grow
     # the channel dim after spatial halving — batch x spatial extent is
-    # the sharded quantity). Match EVERY shape on any all-gather /
-    # all-gather-start line (async forms carry tuple shapes).
-    full = 8 * 32 * 32 * 3
-    for ln in (l for l in hlo.splitlines() if "all-gather" in l):
-        for m in re.finditer(r"\w+\[([\d,]+)\]", ln):
-            dims = [int(d) for d in m.group(1).split(",") if d]
-            numel = int(np.prod(dims)) if dims else 0
-            assert numel < full, (numel, ln)
+    # the sharded quantity). The library check matches EVERY shape on any
+    # all-gather / all-gather-start line (async forms carry tuple shapes).
+    assert_no_collective_as_large_as(hlo, 8 * 32 * 32 * 3)
 
 
 # ---------------------------------------------- latency-hiding schedule
-
-
-def _sub_jaxprs(params):
-    for p in params.values():
-        vals = p if isinstance(p, (list, tuple)) else [p]
-        for q in vals:
-            if hasattr(q, "eqns"):
-                yield q
-            elif hasattr(q, "jaxpr") and hasattr(q.jaxpr, "eqns"):
-                yield q.jaxpr
-
-
-def _scan_ppermute_from_carry_flags(jaxpr, out):
-    """For every ppermute directly inside a lax.scan body: True iff its
-    operand is a scan CARRY invar (i.e. the transfer consumes the previous
-    tick's value and has no data dependence on this tick's compute)."""
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "scan":
-            body = eqn.params["jaxpr"].jaxpr
-            nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
-            carry = set(map(id, body.invars[nc:nc + nk]))
-            for e2 in body.eqns:
-                if e2.primitive.name == "ppermute":
-                    out.append(id(e2.invars[0]) in carry)
-            _scan_ppermute_from_carry_flags(body, out)
-        else:
-            for sj in _sub_jaxprs(eqn.params):
-                _scan_ppermute_from_carry_flags(sj, out)
+# (jaxpr inspection routes through p2p_tpu.analysis.jaxpr_lint — the
+# single source of truth the lint CLI and these pins share)
 
 
 def test_pp_overlap_forward_bitwise(devices8):
@@ -239,6 +208,11 @@ def test_pp_overlap_schedule_issues_transfer_from_carry(devices8):
     overlap removes. Pinned on the jaxpr (the schedule structure XLA
     receives); the compiled HLO must still carry the collective. Mirrors
     the no-all-gather pin style: assert on the program, not on timing."""
+    from p2p_tpu.analysis.jaxpr_lint import (
+        assert_collective_present,
+        scan_ppermute_carry_flags,
+    )
+
     mcfg, _, v, x = _setup(norm="batch", n_blocks=4)
     mesh = make_mesh(MeshSpec(data=1, pipe=2), devices=devices8[:2])
     x_mb = x.reshape(4, 2, 32, 32, 3)
@@ -247,8 +221,7 @@ def test_pp_overlap_schedule_issues_transfer_from_carry(devices8):
     for ov in (False, True):
         jx = jax.make_jaxpr(lambda vr, xm: pp_expand_forward(
             mcfg, vr, xm, mesh, overlap=ov))(v, x_mb)
-        found = []
-        _scan_ppermute_from_carry_flags(jx.jaxpr, found)
+        found = scan_ppermute_carry_flags(jx.jaxpr)
         assert found, f"no ppermute found in the scan body (overlap={ov})"
         flags[ov] = found
     assert all(flags[True]), flags    # overlapped: issued from the carry
@@ -259,7 +232,7 @@ def test_pp_overlap_schedule_issues_transfer_from_carry(devices8):
     hlo = jax.jit(lambda vr, xm: pp_expand_forward(
         mcfg, vr, xm, mesh, overlap=True)).lower(
             v, x_mb).compile().as_text()
-    assert "collective-permute" in hlo
+    assert_collective_present(hlo, "collective-permute")
 
 
 def test_pp_overlap_grads_and_quant_match_serial(devices8):
