@@ -1,24 +1,39 @@
 """Static-analysis subsystem — the standing correctness gate.
 
-Three analyzers over one structured-findings format
+Six analyzers over one structured-findings format
 (:mod:`p2p_tpu.analysis.findings`; waivable in-source via
 ``# p2p-lint: disable=<rule> -- reason``):
 
 - :mod:`p2p_tpu.analysis.sharding_audit` — statically verify a
-  partition-rule table against an ``eval_shape``-built state tree: dead/
-  shadowed rules, unknown mesh axes, indivisible shards, plus the
-  ``tp``-diff migration worklist (ROADMAP item 3).
+  partition-rule table (predicate rules included) against an
+  ``eval_shape``-built state tree: dead/shadowed rules, unknown mesh
+  axes, indivisible shards, plus the ``tp``-diff migration worklist
+  (ROADMAP item 3; the facades family is drained —
+  ``parallel/rules.tp_equivalence_rules``).
+- :mod:`p2p_tpu.analysis.collective_consistency` — the multi-host-hang
+  lint: host-side collectives reachable under per-host-divergent
+  predicates or after divergent early exits, plus collectives under
+  ``lax.cond`` in traced programs.
+- :mod:`p2p_tpu.analysis.memory_audit` — per-device HBM budget table
+  (state bytes under the live layout law + traced liveness activation
+  peak), buffer-donation markers on lowered train steps, and the
+  serving dead-restore check.
+- :mod:`p2p_tpu.analysis.concurrency_lint` — host-concurrency races:
+  signal-handler reentrancy, unlocked shared-state mutation in
+  lock-owning classes, atexit-vs-thread shutdown ordering.
 - :mod:`p2p_tpu.analysis.jaxpr_lint` — the reusable jaxpr/HLO structural
   pin library (collective census, scan-carry ppermute, activation-gather
-  bounds, host-callback and f32-leak detectors). tests/test_pp.py and
-  tests/test_ops.py import their pins from here.
+  bounds, host-callback detector with partial resolution, f32-leak
+  detector). tests/test_pp.py and tests/test_ops.py import their pins
+  from here.
 - :mod:`p2p_tpu.analysis.ast_rules` — project AST lints over ``p2p_tpu/``
   (traced randomness, ``jax.debug`` outside obs, hot-loop host syncs,
   CLI↔config flag drift).
 
 Frontend: ``python -m p2p_tpu.cli.lint --strict`` (the CI gate) —
 docs/STATIC_ANALYSIS.md has the rule catalog and waiver policy. Every
-analyzer is ``eval_shape``/trace/text-based: zero device compute, CPU-safe.
+analyzer is ``eval_shape``/trace/lowering-text-based: zero device
+compute, CPU-safe.
 """
 
 from p2p_tpu.analysis.findings import (  # noqa: F401

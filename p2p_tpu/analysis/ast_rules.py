@@ -58,8 +58,9 @@ CLI_ZONE = ("cli/",)
 _HOST_SYNC_CALLS = {"jax.device_get"}
 
 
-def _dotted(node: ast.AST) -> Optional[str]:
-    """``a.b.c`` for an Attribute/Name chain, else None."""
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None — shared by every
+    AST-family analyzer (collective_consistency, concurrency_lint)."""
     parts: List[str] = []
     while isinstance(node, ast.Attribute):
         parts.append(node.attr)
@@ -68,6 +69,9 @@ def _dotted(node: ast.AST) -> Optional[str]:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return None
+
+
+_dotted = dotted_name
 
 
 def _in_zone(relpath: str, dirs: Sequence[str] = (),
@@ -225,15 +229,19 @@ class _Visitor(ast.NodeVisitor):
         return self.findings
 
 
-def lint_source(relpath: str, text: str) -> List[Finding]:
+def lint_source(relpath: str, text: str,
+                tree: Optional[ast.AST] = None) -> List[Finding]:
     """All findings for one module, pragmas applied. ``relpath`` is the
-    package-relative path ('/'-separated, e.g. ``train/step.py``)."""
-    try:
-        tree = ast.parse(text)
-    except SyntaxError as e:
-        return [Finding(rule="ast-syntax-error", severity=ERROR,
-                        file=relpath, line=e.lineno or 1,
-                        message=f"unparseable module: {e.msg}")]
+    package-relative path ('/'-separated, e.g. ``train/step.py``);
+    ``tree`` lets a caller share one parse across the AST-family
+    analyzers."""
+    if tree is None:
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            return [Finding(rule="ast-syntax-error", severity=ERROR,
+                            file=relpath, line=e.lineno or 1,
+                            message=f"unparseable module: {e.msg}")]
     imports_random = any(
         (isinstance(n, ast.Import)
          and any(a.name == "random" for a in n.names))
@@ -247,24 +255,13 @@ def lint_package(pkg_root: Optional[str] = None) -> Report:
     """Run the AST pass over every module of ``p2p_tpu/`` (default: the
     installed package directory). Findings keep package-relative paths;
     pragma waivers are resolved against the real files."""
-    if pkg_root is None:
-        import p2p_tpu
+    from p2p_tpu.analysis.findings import iter_package_sources
 
-        pkg_root = os.path.dirname(os.path.abspath(p2p_tpu.__file__))
     report = Report()
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, fn)
-            rel = os.path.relpath(full, pkg_root).replace(os.sep, "/")
-            try:
-                with open(full, encoding="utf-8") as fh:
-                    text = fh.read()
-            except OSError as e:
-                report.add(Finding(rule="ast-unreadable", severity=ERROR,
-                                   file=rel, message=str(e)))
-                continue
-            report.extend(lint_source(rel, text))
+    for rel, text, err in iter_package_sources(pkg_root):
+        if text is None:
+            report.add(Finding(rule="ast-unreadable", severity=ERROR,
+                               file=rel, message=str(err)))
+            continue
+        report.extend(lint_source(rel, text))
     return report

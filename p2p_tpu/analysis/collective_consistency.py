@@ -1,0 +1,371 @@
+"""Collective-consistency checker — the multi-host-hang lint.
+
+Every host of a multi-process run must issue the SAME collectives in the
+SAME order; one host branching away from (or bailing out before) a
+collective leaves every other host blocked in it forever — the classic
+multi-host hang, and exactly the failure mode the elastic seam (PR 7) and
+the recovery ladder (PR 5) are most exposed to: both sit between a LOCAL
+observation (a signal flag, a health verdict, an injected fault) and a
+cross-host agreement point.
+
+Two AST rules over the host-side control flow of ``p2p_tpu/`` plus one
+jaxpr rule over the traced step programs:
+
+- ``collective-divergent-branch`` (error): a collective call lexically
+  inside an ``if``/``while`` whose predicate the analyzer cannot prove
+  host-uniform, or inside an ``except`` handler (one host's exception is
+  the canonical divergent predicate). Host-uniform means: built only from
+  constants and ``jax.process_count()`` (including names assigned from
+  them in the same function). ``jax.process_index()`` is deliberately NOT
+  uniform — it is the per-host value.
+- ``collective-after-divergent-exit`` (error): a collective call in a
+  function where a lexically-earlier ``return``/``raise``/``break``/
+  ``continue`` sits under a non-uniform predicate (or in an ``except``
+  handler). Hosts taking that early exit skip the collective the others
+  enter — the same hang with the branch inverted.
+- ``jaxpr-collective-under-cond`` (warning): a collective primitive inside
+  a ``lax.cond`` branch of a traced program. The repo's in-graph guards
+  use ``where``-selects precisely so every device executes the same
+  collective schedule; a psum under a data-dependent cond re-introduces
+  the divergence in-graph.
+
+What counts as a collective: the raw ``jax.experimental.multihost_utils``
+entry points, plus the repo's own documented collective-bearing helpers
+(``PreemptionGuard.should_stop``, ``poll_preempt``,
+``combine_process_metric_stats``, ``MetricsRegistry.aggregate``) — the
+curated list below. The analyzer is intentionally conservative: a site it
+cannot prove uniform is a finding; provably-aligned protocols (e.g. the
+preemption guard's poll-counter cadence) carry an in-source waiver pragma
+stating the alignment argument — the waiver IS the documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from p2p_tpu.analysis.ast_rules import dotted_name as _dotted
+from p2p_tpu.analysis.findings import (
+    ERROR,
+    WARNING,
+    Finding,
+    apply_pragma_waivers,
+)
+
+RULE_DIVERGENT_BRANCH = "collective-divergent-branch"
+RULE_DIVERGENT_EXIT = "collective-after-divergent-exit"
+RULE_COND_COLLECTIVE = "jaxpr-collective-under-cond"
+
+#: raw multi-host collective entry points (matched on the final dotted
+#: segment, so ``multihost_utils.process_allgather`` and a bare import
+#: both hit)
+COLLECTIVE_CALLS = frozenset({
+    "process_allgather",
+    "sync_global_devices",
+    "broadcast_one_to_all",
+})
+
+#: repo functions/methods documented to enter collectives on >1 process
+#: (their OWN bodies are linted too; calling them inherits the hazard)
+COLLECTIVE_BEARING = frozenset({
+    "should_stop",                   # PreemptionGuard agreement allgather
+    "poll_preempt",                  # train loops' step-boundary poll
+    "combine_process_metric_stats",  # eval stats allgather
+    "aggregate",                     # MetricsRegistry cross-host reduce
+})
+
+#: calls whose value is identical on every host
+_UNIFORM_CALLS = frozenset({"jax.process_count", "process_count"})
+
+
+def _collective_name(call: ast.Call) -> Optional[str]:
+    """The collective a Call enters, or None."""
+    func = call.func
+    name = None
+    if isinstance(func, ast.Attribute):
+        name = func.attr
+    elif isinstance(func, ast.Name):
+        name = func.id
+    if name in COLLECTIVE_CALLS or name in COLLECTIVE_BEARING:
+        return name
+    return None
+
+
+def _uniform_expr(node: ast.AST, uniform_names: Set[str]) -> bool:
+    """True iff the analyzer can PROVE the expression is host-uniform."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in uniform_names
+    if isinstance(node, ast.Call):
+        dotted = _dotted(node.func)
+        return (dotted in _UNIFORM_CALLS
+                or (dotted or "").endswith(".process_count")) \
+            and not node.args and not node.keywords
+    if isinstance(node, ast.Compare):
+        return (_uniform_expr(node.left, uniform_names)
+                and all(_uniform_expr(c, uniform_names)
+                        for c in node.comparators))
+    if isinstance(node, ast.BoolOp):
+        return all(_uniform_expr(v, uniform_names) for v in node.values)
+    if isinstance(node, ast.BinOp):
+        return (_uniform_expr(node.left, uniform_names)
+                and _uniform_expr(node.right, uniform_names))
+    if isinstance(node, ast.UnaryOp):
+        return _uniform_expr(node.operand, uniform_names)
+    return False
+
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_EXITS = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+def _collect_uniform_names(fn: ast.AST) -> Set[str]:
+    """Names provably host-uniform EVERYWHERE in the function: every
+    binding must be a direct assignment from a uniform expression — a
+    name with ANY other binding (a later ``n = self._requested``, a loop
+    target, an augmented assign) is demoted, or the flow-insensitive
+    const-prop would bless a divergent predicate through its earlier
+    uniform assignment."""
+    tainted: Set[str] = set()
+    assigns = []   # (name, value) for single-Name plain assignments
+
+    def taint_targets(target_node):
+        for t in ast.walk(target_node):
+            if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+                tainted.add(t.id)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            if len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                assigns.append((node.targets[0].id, node.value))
+            else:
+                for t in node.targets:   # tuple-unpack / multi-target
+                    taint_targets(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                               ast.NamedExpr)):
+            taint_targets(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            taint_targets(node.target)
+        elif isinstance(node, ast.comprehension):
+            taint_targets(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    taint_targets(item.optional_vars)
+    # optimistic greatest fixpoint: start from every non-tainted assigned
+    # name, then repeatedly DROP any name with an assignment that is not
+    # uniform under the current set — uniform-from-uniform chains
+    # (``world = n`` after ``n = jax.process_count()``) survive, while a
+    # later ``n = self._requested`` demotes ``n`` AND everything derived
+    # from it, in as many rounds as the chain is deep
+    by_name: Dict[str, List[ast.AST]] = {}
+    for name, value in assigns:
+        by_name.setdefault(name, []).append(value)
+    uniform = {n for n in by_name if n not in tainted}
+    for _ in range(len(by_name) + 1):
+        dropped = {
+            n for n in uniform
+            if not all(_uniform_expr(v, uniform) for v in by_name[n])
+        }
+        if not dropped:
+            break
+        uniform -= dropped
+    return uniform
+
+
+def _calls_in(node: ast.AST) -> List[ast.Call]:
+    """Call nodes in a statement, NOT descending into nested functions
+    (their bodies run at call time, under their own analysis)."""
+    out: List[ast.Call] = []
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FN_NODES) and n is not node:
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+class _FunctionPass:
+    def __init__(self, relpath: str, fn, uniform_names: Set[str]):
+        self.relpath = relpath
+        self.fn = fn
+        self.uniform = uniform_names
+        self.findings: List[Finding] = []
+        # (line, why) of the first divergent early exit seen so far
+        self.divergent_exit: Optional[Tuple[int, str]] = None
+
+    def run(self) -> List[Finding]:
+        self._walk(self.fn.body, divergent=None)
+        return self.findings
+
+    # -- statement walk (source order) ----------------------------------
+    def _walk(self, stmts: Sequence[ast.stmt], divergent: Optional[str]):
+        for st in stmts:
+            if isinstance(st, _EXITS) and divergent is not None \
+                    and self.divergent_exit is None:
+                self.divergent_exit = (st.lineno, divergent)
+            self._scan_calls(st, divergent)
+            self._recurse(st, divergent)
+
+    def _scan_calls(self, st: ast.stmt, divergent: Optional[str]):
+        # only this statement's own expressions — compound bodies recurse
+        # with their own divergence context (_shallow strips them)
+        for call in _calls_in(_shallow(st)):
+            name = _collective_name(call)
+            if name is None:
+                continue
+            if divergent is not None:
+                self.findings.append(Finding(
+                    rule=RULE_DIVERGENT_BRANCH, severity=ERROR,
+                    file=self.relpath, line=call.lineno,
+                    message=f"collective {name!r} reachable only under a "
+                            f"per-host-divergent predicate ({divergent}) — "
+                            "a host that skips it hangs every other host's "
+                            "next collective",
+                ))
+            elif self.divergent_exit is not None:
+                line, why = self.divergent_exit
+                self.findings.append(Finding(
+                    rule=RULE_DIVERGENT_EXIT, severity=ERROR,
+                    file=self.relpath, line=call.lineno,
+                    message=f"collective {name!r} follows a divergent "
+                            f"early exit at line {line} ({why}) — hosts "
+                            "taking that exit never enter this collective "
+                            "while the rest block in it",
+                ))
+
+    def _recurse(self, st: ast.stmt, divergent: Optional[str]):
+        if isinstance(st, (ast.If, ast.While)):
+            test_div = divergent
+            if test_div is None \
+                    and not _uniform_expr(st.test, self.uniform):
+                src = ast.unparse(st.test) if hasattr(ast, "unparse") \
+                    else "<predicate>"
+                test_div = f"branch on {src!r} at line {st.lineno}"
+            self._walk(st.body, test_div)
+            self._walk(st.orelse, test_div)
+        elif isinstance(st, ast.Try):
+            self._walk(st.body, divergent)
+            for h in st.handlers:
+                why = divergent or (
+                    f"except handler at line {h.lineno} — an exception "
+                    "raised on one host only")
+                self._walk(h.body, why)
+            self._walk(st.orelse, divergent)
+            self._walk(st.finalbody, divergent)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._walk(st.body, divergent)
+            self._walk(st.orelse, divergent)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            self._walk(st.body, divergent)
+        # nested function definitions get their own _FunctionPass
+
+
+def _shallow(st: ast.stmt) -> ast.stmt:
+    """A copy-free view of a statement excluding compound bodies (which
+    the walk visits with their own divergence context)."""
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                       ast.ClassDef)):
+        # defining is not calling: the body runs at CALL time, under its
+        # own _FunctionPass — scanning it here would flag a collective in
+        # a helper merely DEFINED inside a divergent branch
+        return ast.Pass()
+    if isinstance(st, (ast.If, ast.While)):
+        return st.test
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return st.iter
+    if isinstance(st, ast.Try):
+        return ast.Pass()   # everything interesting is in the bodies
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        # context-manager expressions execute unconditionally at entry
+        return ast.Tuple(elts=[i.context_expr for i in st.items],
+                         ctx=ast.Load())
+    return st
+
+
+def lint_collective_source(relpath: str, text: str,
+                           tree: Optional[ast.Module] = None,
+                           ) -> List[Finding]:
+    """All collective-consistency findings for one module (pragmas
+    applied). ``tree`` lets a caller share one parse across the
+    AST-family analyzers (cli/lint.py's single package walk)."""
+    if tree is None:
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            return []   # the AST pass reports unparseable modules already
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            uniform = _collect_uniform_names(node)
+            findings.extend(
+                _FunctionPass(relpath, node, uniform).run())
+    return apply_pragma_waivers(findings, sources={relpath: text})
+
+
+def lint_package_collectives(pkg_root: Optional[str] = None) -> List[Finding]:
+    """The collective-consistency pass over every module of ``p2p_tpu/``."""
+    from p2p_tpu.analysis.findings import iter_package_sources
+
+    out: List[Finding] = []
+    for rel, text, _err in iter_package_sources(pkg_root):
+        if text is not None:   # ast_rules reports unreadable modules
+            out.extend(lint_collective_source(rel, text))
+    return out
+
+
+# ------------------------------------------------------ traced programs
+
+
+def collectives_under_cond(jaxpr, tag: str = "program") -> List[Finding]:
+    """Findings for collective primitives inside ``lax.cond`` branches of
+    a traced program — the in-graph twin of the AST rules: a collective
+    whose execution depends on a traced predicate diverges the device
+    collective schedule exactly like a host branch diverges the host one.
+    (The repo's in-jit guards use ``where``-selects, never cond, for this
+    reason — resilience/health.py.)"""
+    from p2p_tpu.analysis.jaxpr_lint import (
+        COLLECTIVE_PRIMITIVES,
+        eqn_location,
+        iter_eqns,
+        normalize_primitive,
+        sub_jaxprs,
+    )
+
+    out: List[Finding] = []
+
+    def branch_collectives(jx):
+        for eqn in iter_eqns(jx):
+            name = normalize_primitive(eqn.primitive.name)
+            if name in COLLECTIVE_PRIMITIVES:
+                yield name, eqn
+
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    stack = [jaxpr]
+    while stack:
+        jx = stack.pop()
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "cond":
+                for br in eqn.params.get("branches", ()):
+                    for name, inner in branch_collectives(br):
+                        fname, line = eqn_location(inner)
+                        out.append(Finding(
+                            rule=RULE_COND_COLLECTIVE, severity=WARNING,
+                            file=fname, line=line,
+                            path=None if fname else tag,
+                            message=f"collective {name!r} inside a "
+                                    f"lax.cond branch of {tag!r} — a "
+                                    "data-dependent predicate diverges "
+                                    "the collective schedule; use a "
+                                    "where-select over the collective's "
+                                    "result instead",
+                        ))
+            else:
+                stack.extend(sub_jaxprs(eqn.params))
+    return out

@@ -1,0 +1,439 @@
+"""Host-concurrency race lint — the threaded surface's standing gate.
+
+The host side of this trainer is genuinely concurrent: SIGTERM handlers
+interrupt the main thread between bytecodes (resilience/preempt.py), the
+obs registry fans records out from sentinel-callback and signal-flush
+threads (obs/registry.py), the serve writer moves D2H+encode onto a pool
+(serve/io.py), and everything registers atexit hooks that run during
+interpreter shutdown. Three AST rules, scoped to what is statically
+checkable:
+
+- ``conc-signal-handler-unsafe`` (error): inside a function installed via
+  ``signal.signal(...)``, a call into locking / buffered-IO / allocating
+  machinery (``.acquire``/``.flush``/``.write``/``.log``/``.record``/
+  ``.inc``/``.observe``/``.export``, ``print``, ``open``, ``logging.*``,
+  or a ``with <...lock...>`` block). A handler runs ON the interrupted
+  main thread, possibly while that thread holds the very lock the call
+  needs — the self-deadlock preempt.py's deferral-thread pattern exists
+  to avoid. The safe pattern: set a flag, hand side effects to a helper
+  thread.
+- ``conc-unlocked-shared-mutation``: in a class that owns a
+  ``threading.Lock`` (assigned in ``__init__``), a mutation of shared
+  state outside a ``with self.<lock>`` block — (a) container attrs
+  initialized to a list/dict/set literal (error), (b) attrs mutated
+  under the lock in one method and without it in another (error — the
+  inconsistent-discipline smell), (c) augmented assignment on a plain
+  attr (warning: ``+=`` is a read-modify-write; lost updates under
+  concurrent callers). ``__init__`` itself is exempt (pre-sharing).
+- ``conc-atexit-thread-join`` (warning): an ``atexit``-registered
+  callable (resolved within the module) whose body joins threads
+  (``.join()`` / ``shutdown(wait=True)``). atexit runs during
+  interpreter shutdown after non-daemon threads were already joined;
+  blocking there wedges exit when a worker is stuck on a lock the dying
+  main thread holds.
+
+Like every analyzer here, provably-safe sites carry in-source waivers
+stating the safety argument (e.g. the serve writer's futures list is
+touched by the single dispatch thread only — the waiver documents the
+contract the next refactor must keep).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from p2p_tpu.analysis.ast_rules import dotted_name as _dotted
+from p2p_tpu.analysis.findings import (
+    ERROR,
+    WARNING,
+    Finding,
+    apply_pragma_waivers,
+)
+
+RULE_SIGNAL_UNSAFE = "conc-signal-handler-unsafe"
+RULE_UNLOCKED_MUTATION = "conc-unlocked-shared-mutation"
+RULE_ATEXIT_JOIN = "conc-atexit-thread-join"
+
+#: attribute-call suffixes that take locks / touch buffered IO — unsafe
+#: on a signal path
+_UNSAFE_HANDLER_CALLS = frozenset({
+    "acquire", "flush", "write", "log", "record", "inc", "observe",
+    "export", "put",
+})
+_UNSAFE_HANDLER_FUNCS = frozenset({"print", "open"})
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` for a ``self.X`` attribute access, else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+# ------------------------------------------------ signal-handler rule
+
+
+def _signal_calls(scope: ast.AST):
+    for c in ast.walk(scope):
+        if isinstance(c, ast.Call) \
+                and (_dotted(c.func) or "").endswith("signal.signal") \
+                and len(c.args) == 2:
+            yield c
+
+
+def _signal_handler_nodes(tree: ast.Module) -> Set[int]:
+    """ids of the FunctionDef nodes registered via ``signal.signal(sig,
+    h)``. Resolution is SCOPED like the atexit rule's: a ``self.X``
+    handler resolves to the ENCLOSING class's method X — two classes
+    sharing a method name must not get each other's bodies audited."""
+    module_fns = {n.name: n for n in tree.body
+                  if isinstance(n, ast.FunctionDef)}
+    out: Set[int] = set()
+    seen: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {n.name: n for n in node.body
+                   if isinstance(n, ast.FunctionDef)}
+        for c in _signal_calls(node):
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            h = c.args[1]
+            name = _self_attr(h) or (
+                h.id if isinstance(h, ast.Name) else None) or (
+                h.attr if isinstance(h, ast.Attribute) else None)
+            target = methods.get(name or "") or module_fns.get(name or "")
+            if target is not None:
+                out.add(id(target))
+    for c in _signal_calls(tree):   # module-level installs
+        if id(c) in seen:
+            continue
+        seen.add(id(c))
+        h = c.args[1]
+        name = (h.id if isinstance(h, ast.Name) else None) or (
+            h.attr if isinstance(h, ast.Attribute) else None)
+        target = module_fns.get(name or "")
+        if target is not None:
+            out.add(id(target))
+    return out
+
+
+def _handler_findings(relpath: str, fn: ast.FunctionDef) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                src = ast.unparse(item.context_expr) \
+                    if hasattr(ast, "unparse") else ""
+                if "lock" in src.lower():
+                    out.append(Finding(
+                        rule=RULE_SIGNAL_UNSAFE, severity=ERROR,
+                        file=relpath, line=node.lineno,
+                        message=f"signal handler {fn.name!r} acquires "
+                                f"{src!r}: the interrupted main thread "
+                                "may already hold it — self-deadlock; "
+                                "defer to a helper thread",
+                    ))
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ""
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else None
+        bad = (attr in _UNSAFE_HANDLER_CALLS
+               or dotted in _UNSAFE_HANDLER_FUNCS
+               or dotted.startswith("logging."))
+        if bad:
+            out.append(Finding(
+                rule=RULE_SIGNAL_UNSAFE, severity=ERROR,
+                file=relpath, line=node.lineno,
+                message=f"signal handler {fn.name!r} calls "
+                        f"{dotted or attr!r} — locking/buffered-IO "
+                        "machinery on the interrupted main thread can "
+                        "self-deadlock; set a flag and defer side "
+                        "effects to a helper thread",
+            ))
+    return out
+
+
+# ------------------------------------------- unlocked-mutation rule
+
+
+_MUTATOR_METHODS = frozenset({
+    "append", "extend", "remove", "insert", "pop", "clear", "update",
+    "add", "discard", "setdefault", "popitem",
+})
+
+
+def _stmt_exprs(st: ast.stmt) -> List[ast.AST]:
+    """The expression roots a statement evaluates ITSELF — compound
+    bodies excluded (the class scan recurses into them with their own
+    with-lock context)."""
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return [st.iter]
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in st.items]
+    if isinstance(st, ast.Try):
+        return []
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+        return []   # defining is not executing
+    return [st]
+
+
+class _ClassScan:
+    """Per-class accounting for the unlocked-shared-mutation rule."""
+
+    def __init__(self, relpath: str, cls: ast.ClassDef):
+        self.relpath = relpath
+        self.cls = cls
+        self.lock_attrs: Set[str] = set()
+        self.container_attrs: Set[str] = set()
+        # attr -> [(line, in_lock, in_init, kind)]
+        self.mutations: List[Tuple[str, int, bool, bool, str]] = []
+
+    def scan(self) -> List[Finding]:
+        for node in self.cls.body:
+            if isinstance(node, ast.FunctionDef):
+                if node.name == "__init__":
+                    self._scan_init(node)
+        if not self.lock_attrs:
+            return []
+        for node in self.cls.body:
+            if isinstance(node, ast.FunctionDef):
+                self._scan_method(node)
+        return self._findings()
+
+    def _scan_init(self, fn: ast.FunctionDef):
+        for node in ast.walk(fn):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target = node.target   # self._sinks: List[Any] = []
+            if target is None:
+                continue
+            attr = _self_attr(target)
+            if attr is not None:
+                v = node.value
+                if isinstance(v, ast.Call):
+                    dotted = _dotted(v.func) or ""
+                    if dotted.endswith("Lock"):   # Lock AND RLock
+                        self.lock_attrs.add(attr)
+                    if dotted in ("list", "dict", "set"):
+                        self.container_attrs.add(attr)
+                if isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                  ast.DictComp, ast.SetComp)):
+                    self.container_attrs.add(attr)
+
+    def _with_locks(self, node: ast.With) -> bool:
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.lock_attrs:
+                return True
+        return False
+
+    def _scan_method(self, fn: ast.FunctionDef):
+        in_init = fn.name == "__init__"
+
+        def walk(stmts: Sequence[ast.stmt], locked: bool):
+            for st in stmts:
+                self._scan_stmt(st, locked, in_init)
+                if isinstance(st, ast.With):
+                    walk(st.body, locked or self._with_locks(st))
+                elif isinstance(st, (ast.If, ast.While)):
+                    walk(st.body, locked)
+                    walk(st.orelse, locked)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    walk(st.body, locked)
+                    walk(st.orelse, locked)
+                elif isinstance(st, ast.Try):
+                    walk(st.body, locked)
+                    for h in st.handlers:
+                        walk(h.body, locked)
+                    walk(st.orelse, locked)
+                    walk(st.finalbody, locked)
+
+        walk(fn.body, False)
+
+    def _scan_stmt(self, st: ast.stmt, locked: bool, in_init: bool):
+        def note(attr, line, kind):
+            self.mutations.append((attr, line, locked, in_init, kind))
+
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    note(attr, st.lineno, "assign")
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        note(attr, st.lineno, "setitem")
+        elif isinstance(st, ast.AugAssign):
+            attr = _self_attr(st.target)
+            if attr is not None:
+                note(attr, st.lineno, "augassign")
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        note(attr, st.lineno, "delitem")
+        # mutator-method calls ANYWHERE in the statement's own
+        # expressions — `x = self._q.pop(0)` / `if self._q.pop():` /
+        # `return self._q.pop()` are the common pop-and-use race shapes,
+        # not just bare `self._q.append(...)` statements. Compound
+        # bodies are excluded (they recurse with their own lock context).
+        for root in _stmt_exprs(st):
+            stack = [root]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    continue   # runs at call time, not here
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _MUTATOR_METHODS:
+                    attr = _self_attr(n.func.value)
+                    if attr is not None:
+                        note(attr, n.lineno, f".{n.func.attr}()")
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _findings(self) -> List[Finding]:
+        locked_attrs = {a for a, _, lk, ini, _ in self.mutations
+                        if lk and not ini}
+        out: List[Finding] = []
+        for attr, line, locked, in_init, kind in self.mutations:
+            if locked or in_init or attr in self.lock_attrs:
+                continue
+            cls = self.cls.name
+            if attr in self.container_attrs:
+                out.append(Finding(
+                    rule=RULE_UNLOCKED_MUTATION, severity=ERROR,
+                    file=self.relpath, line=line,
+                    message=f"{cls}.{attr} ({kind}) mutated outside "
+                            f"the class's lock — {cls} owns "
+                            f"{sorted(self.lock_attrs)} precisely because "
+                            "it is shared across threads; lock the "
+                            "mutation (and iterate over snapshots)",
+                ))
+            elif attr in locked_attrs:
+                out.append(Finding(
+                    rule=RULE_UNLOCKED_MUTATION, severity=ERROR,
+                    file=self.relpath, line=line,
+                    message=f"{cls}.{attr} ({kind}) mutated WITHOUT the "
+                            "lock here but WITH it elsewhere in the "
+                            "class — inconsistent locking discipline",
+                ))
+            elif kind == "augassign":
+                out.append(Finding(
+                    rule=RULE_UNLOCKED_MUTATION, severity=WARNING,
+                    file=self.relpath, line=line,
+                    message=f"{cls}.{attr} += outside the class's lock: "
+                            "read-modify-write races lose updates under "
+                            "concurrent callers",
+                ))
+        return out
+
+
+# --------------------------------------------------- atexit-join rule
+
+
+def _atexit_findings(relpath: str, tree: ast.Module) -> List[Finding]:
+    # Handler resolution is SCOPED: a ``self.X`` handler resolves to the
+    # method X of the ENCLOSING class (a module with five ``close``
+    # methods must not audit the first one for every registration —
+    # both false negatives and phantom repeats); bare-name handlers
+    # resolve module-level.
+    module_fns: Dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            module_fns.setdefault(node.name, node)
+
+    def class_methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+        return {n.name: n for n in cls.body
+                if isinstance(n, ast.FunctionDef)}
+
+    # (register-call, resolver dict) pairs in their resolution scope
+    sites: List[Tuple[ast.Call, Dict[str, ast.FunctionDef]]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods = class_methods(node)
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) \
+                        and (_dotted(inner.func) or "").endswith(
+                            "atexit.register") and inner.args:
+                    sites.append((inner, methods))
+        elif isinstance(node, ast.Call) \
+                and (_dotted(node.func) or "").endswith("atexit.register") \
+                and node.args:
+            sites.append((node, module_fns))
+    # class-scoped register calls were collected twice (ast.walk visits
+    # them at module level too) — keep the class-scoped resolution
+    seen_calls = set()
+    out: List[Finding] = []
+    for call, scope in sites:
+        if id(call) in seen_calls:
+            continue
+        seen_calls.add(id(call))
+        h = call.args[0]
+        name = _self_attr(h) or (h.id if isinstance(h, ast.Name) else None) \
+            or (h.attr if isinstance(h, ast.Attribute) else None)
+        target = scope.get(name or "") or (
+            module_fns.get(name or "") if scope is not module_fns else None)
+        if target is None:
+            continue
+        for inner in ast.walk(target):
+            if isinstance(inner, ast.Call) \
+                    and isinstance(inner.func, ast.Attribute):
+                is_join = inner.func.attr == "join" and not inner.args
+                is_shutdown = inner.func.attr == "shutdown" and any(
+                    kw.arg == "wait" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value for kw in inner.keywords)
+                if is_join or is_shutdown:
+                    out.append(Finding(
+                        rule=RULE_ATEXIT_JOIN, severity=WARNING,
+                        file=relpath, line=inner.lineno,
+                        message=f"atexit-registered {name!r} blocks on "
+                                f"thread {'join' if is_join else 'shutdown(wait=True)'} "
+                                "— atexit runs during interpreter "
+                                "shutdown; a stuck worker wedges process "
+                                "exit",
+                    ))
+    return out
+
+
+# --------------------------------------------------------- entry points
+
+
+def lint_concurrency_source(relpath: str, text: str,
+                            tree: Optional[ast.Module] = None,
+                            ) -> List[Finding]:
+    if tree is None:
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            return []   # ast_rules reports unparseable modules
+    findings: List[Finding] = []
+    handlers = _signal_handler_nodes(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and id(node) in handlers:
+            findings.extend(_handler_findings(relpath, node))
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_ClassScan(relpath, node).scan())
+    findings.extend(_atexit_findings(relpath, tree))
+    return apply_pragma_waivers(findings, sources={relpath: text})
+
+
+def lint_package_concurrency(pkg_root: Optional[str] = None) -> List[Finding]:
+    from p2p_tpu.analysis.findings import iter_package_sources
+
+    out: List[Finding] = []
+    for rel, text, _err in iter_package_sources(pkg_root):
+        if text is not None:   # ast_rules reports unreadable modules
+            out.extend(lint_concurrency_source(rel, text))
+    return out

@@ -139,6 +139,32 @@ def apply_pragma_waivers(
     return out + bad
 
 
+def iter_package_sources(pkg_root: Optional[str] = None):
+    """Yield ``(relpath, text, error)`` for every ``.py`` module of
+    ``p2p_tpu/`` (default: the installed package directory) — the ONE
+    walk every AST-family analyzer shares. ``text`` is None exactly when
+    ``error`` holds the read failure; ``relpath`` is package-relative,
+    '/'-separated."""
+    import os
+
+    if pkg_root is None:
+        import p2p_tpu
+
+        pkg_root = os.path.dirname(os.path.abspath(p2p_tpu.__file__))
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, pkg_root).replace(os.sep, "/")
+            try:
+                with open(full, encoding="utf-8") as fh:
+                    yield rel, fh.read(), None
+            except OSError as e:
+                yield rel, None, e
+
+
 class Report:
     """An ordered finding collection with the gate semantics baked in."""
 

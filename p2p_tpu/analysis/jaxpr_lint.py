@@ -219,25 +219,63 @@ def scan_ppermute_carry_flags(jaxpr) -> List[bool]:
 # ------------------------------------------------------- lint findings
 
 
+def resolve_callback_target(eqn) -> Optional[str]:
+    """The USER function behind a callback eqn, or None.
+
+    ``jax.debug.callback`` wraps the user callable in a ``_flat_callback``
+    closure, and the repo's obs taps bind theirs through
+    ``functools.partial`` (obs/taps.py ``nan_sentinel``) — so the raw
+    ``eqn.params['callback']`` never names the function a human would
+    recognize. Resolution: look through the jax flat-callback closure,
+    then through ONE level of ``functools.partial`` (the repo's binding
+    idiom; deeper nesting stays anonymous on purpose — resolve it when a
+    real tap needs it)."""
+    import functools
+
+    cb = eqn.params.get("callback")
+    if cb is None:
+        return None
+    if getattr(cb, "__name__", "") == "_flat_callback" \
+            and getattr(cb, "__closure__", None):
+        for cell in cb.__closure__:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if callable(v):
+                cb = v
+                break
+    if isinstance(cb, functools.partial):
+        cb = cb.func
+    return getattr(cb, "__name__", None) or type(cb).__name__
+
+
 def host_callback_findings(jaxpr, tag: str = "program",
                            allow: Iterable[str] = ()) -> List[Finding]:
     """Findings for host callbacks inside a supposedly-hot program.
 
-    ``allow`` exempts primitive names (e.g. ``debug_callback`` when the
-    program deliberately carries an obs tap)."""
-    allowed = {normalize_primitive(a) for a in allow}
+    ``allow`` exempts PRIMITIVE names (``debug_callback`` — every debug
+    callback passes) or RESOLVED target function names (``_on_counts`` —
+    only the obs sentinel's own callback passes, anything else still
+    flags; see :func:`resolve_callback_target`)."""
+    allowed = {normalize_primitive(a) for a in allow} | set(allow)
     out: List[Finding] = []
     for eqn in iter_eqns(jaxpr):
         name = normalize_primitive(eqn.primitive.name)
-        if name in _CALLBACK_PRIMITIVES and name not in allowed:
-            fname, line = eqn_location(eqn)
-            out.append(Finding(
-                rule=RULE_HOST_CALLBACK, severity=ERROR,
-                file=fname, line=line, path=None if fname else tag,
-                message=f"host callback {name!r} in hot path {tag!r} — "
-                        "route telemetry through p2p_tpu/obs seams or keep "
-                        "it out of the jitted step",
-            ))
+        if name not in _CALLBACK_PRIMITIVES:
+            continue
+        target = resolve_callback_target(eqn)
+        if name in allowed or (target is not None and target in allowed):
+            continue
+        fname, line = eqn_location(eqn)
+        what = f"{name}->{target}" if target else name
+        out.append(Finding(
+            rule=RULE_HOST_CALLBACK, severity=ERROR,
+            file=fname, line=line, path=None if fname else tag,
+            message=f"host callback {what!r} in hot path {tag!r} — "
+                    "route telemetry through p2p_tpu/obs seams or keep "
+                    "it out of the jitted step",
+        ))
     return out
 
 
@@ -249,8 +287,14 @@ def f32_leak_findings(jaxpr, tag: str = "program",
     The check is on OPERANDS (not outputs): f32 accumulation via
     ``preferred_element_type`` is the policy-conformant pattern, an f32
     input tensor is a leak — it forces the full-precision MXU path and
-    doubles the operand's HBM traffic."""
-    out: List[Finding] = []
+    doubles the operand's HBM traffic.
+
+    Findings dedupe per source location: one line of model code expands
+    to many eqns (taps, fwd + transpose instances, microbatches) but is
+    ONE policy decision — the finding carries the eqn count instead of
+    repeating per eqn (which would also let a single waived line inflate
+    the waiver-count metric by hundreds)."""
+    seen: dict = {}
     for eqn in iter_eqns(jaxpr):
         if eqn.primitive.name not in ("dot_general", "conv_general_dilated"):
             continue
@@ -260,11 +304,20 @@ def f32_leak_findings(jaxpr, tag: str = "program",
             dtypes.append(str(getattr(aval, "dtype", "?")))
         if any(d == "float32" for d in dtypes):
             fname, line = eqn_location(eqn)
-            out.append(Finding(
-                rule=RULE_F32_LEAK, severity=ERROR,
-                file=fname, line=line, path=None if fname else tag,
-                message=f"{eqn.primitive.name} with float32 operand "
-                        f"{tuple(dtypes)} under declared {policy} policy "
-                        f"in {tag!r}",
-            ))
+            key = (fname, line, eqn.primitive.name, tuple(dtypes))
+            if key in seen:
+                seen[key] = (seen[key][0], seen[key][1] + 1)
+            else:
+                seen[key] = (Finding(
+                    rule=RULE_F32_LEAK, severity=ERROR,
+                    file=fname, line=line, path=None if fname else tag,
+                    message=f"{eqn.primitive.name} with float32 operand "
+                            f"{tuple(dtypes)} under declared {policy} "
+                            f"policy in {tag!r}",
+                ), 1)
+    out: List[Finding] = []
+    for f, n in seen.values():
+        if n > 1:
+            f.message += f" (x{n} eqns at this line)"
+        out.append(f)
     return out

@@ -109,7 +109,7 @@ def _table_axis_findings(compiled, sizes: Dict[str, int]) -> List[Finding]:
     shadowed rule's bogus axis is still reported (per-leaf checking would
     mask it — the rule never fires on anything)."""
     out: List[Finding] = []
-    for idx, (_, pat, spec) in enumerate(compiled):
+    for idx, (_, pat, spec, _pred) in enumerate(compiled):
         missing = sorted({a for _, axes in _spec_partitions(spec)
                           for a in axes if a not in sizes})
         if missing:
@@ -156,9 +156,14 @@ def audit_rules(rules: Sequence[Tuple[str, Any]], tree: Any,
     """Statically verify a rule table against a state tree (and optionally
     a mesh topology). Returns findings; an empty list is the audit's
     "every leaf matches, every rule earns its place" certificate."""
+    from p2p_tpu.parallel.rules import rule_parts
+
     sizes = mesh_axis_sizes(mesh)
     leaves = named_leaves(tree)
-    compiled = [(re.compile(pat), pat, spec) for pat, spec in rules]
+    compiled = []
+    for rule in rules:
+        pat, spec, pred = rule_parts(rule)
+        compiled.append((re.compile(pat), pat, spec, pred))
     findings: List[Finding] = []
     if sizes is not None:
         findings.extend(_table_axis_findings(compiled, sizes))
@@ -168,8 +173,9 @@ def audit_rules(rules: Sequence[Tuple[str, Any]], tree: Any,
     for name, _, shape in leaves:
         if _is_scalar(shape):
             continue  # the scalar floor never consults the table
-        for idx, (cre, pat, spec) in enumerate(compiled):
-            if cre.search(name) is not None:
+        for idx, (cre, pat, spec, pred) in enumerate(compiled):
+            if cre.search(name) is not None \
+                    and (pred is None or pred(tuple(shape))):
                 fired[idx] += 1
                 claimed_by[name] = idx
                 findings.extend(_spec_findings(
@@ -184,13 +190,17 @@ def audit_rules(rules: Sequence[Tuple[str, Any]], tree: Any,
                         f"(\".*\", P())",
             ))
 
-    for idx, (cre, pat, spec) in enumerate(compiled):
+    for idx, (cre, pat, spec, pred) in enumerate(compiled):
         if fired[idx] or pat in _CATCH_ALL:
             continue
+        # a predicate rule "matches" a leaf only when its predicate also
+        # accepts the shape — a regex-hit/predicate-miss leaf is neither
+        # claimed nor shadow evidence
         shadow_hits = [(name, claimed_by[name])
                        for name, _, shape in leaves
                        if not _is_scalar(shape) and name in claimed_by
-                       and cre.search(name) is not None]
+                       and cre.search(name) is not None
+                       and (pred is None or pred(tuple(shape)))]
         if shadow_hits:
             name0, by = min(shadow_hits, key=lambda t: t[1])
             by_pat = compiled[by][1]
@@ -229,11 +239,14 @@ def tp_rule_gaps(tree: Any, rules: Optional[Sequence[Tuple[str, Any]]] = None,
     """
     from jax.sharding import PartitionSpec as P
 
-    from p2p_tpu.parallel.rules import REPLICATED_RULES
+    from p2p_tpu.parallel.rules import REPLICATED_RULES, rule_parts
     from p2p_tpu.parallel.tp import tp_leaf_spec
 
     rules = REPLICATED_RULES if rules is None else rules
-    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    compiled = []
+    for rule in rules:
+        pat, spec, pred = rule_parts(rule)
+        compiled.append((re.compile(pat), spec, pred))
     worklist: List[dict] = []
     findings: List[Finding] = []
     for name, keystr, shape in named_leaves(tree):
@@ -241,8 +254,9 @@ def tp_rule_gaps(tree: Any, rules: Optional[Sequence[Tuple[str, Any]]] = None,
             continue
         tp_spec = tp_leaf_spec(keystr, shape, axis_size, min_ch)
         rule_spec = None
-        for cre, spec in compiled:
-            if cre.search(name) is not None:
+        for cre, spec, pred in compiled:
+            if cre.search(name) is not None \
+                    and (pred is None or pred(tuple(shape))):
                 rule_spec = spec
                 break
         if rule_spec is None or tuple(tp_spec) == tuple(rule_spec):

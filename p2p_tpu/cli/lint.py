@@ -1,25 +1,39 @@
 """Static-analysis frontend — ``python -m p2p_tpu.cli.lint --strict``.
 
-The standing CI correctness gate (docs/STATIC_ANALYSIS.md). Runs the three
-:mod:`p2p_tpu.analysis` analyzers and fails on any unwaived finding:
+The standing CI correctness gate (docs/STATIC_ANALYSIS.md). Six analyzers
+share one findings format and fail the gate on any unwaived finding:
 
 1. **AST rules** over every module of ``p2p_tpu/`` (traced randomness,
    ``jax.debug`` outside obs, hot-loop host syncs, CLI↔config flag drift).
-2. **Sharding audit**: the declarative rule tables (parallel/rules.py)
+2. **Collective-consistency checker** (analysis/collective_consistency):
+   host-side collectives (the preempt-agreement allgather, eval stat
+   combines, registry aggregation) reachable under per-host-divergent
+   predicates or after divergent early exits — the multi-host-hang lint.
+3. **Concurrency race lint** (analysis/concurrency_lint): signal-handler
+   reentrancy, unlocked shared-state mutation in lock-owning classes,
+   atexit-vs-thread shutdown ordering.
+4. **Sharding audit**: the declarative rule tables (parallel/rules.py)
    statically verified against full-size preset TrainStates built
-   shape-only via ``jax.eval_shape`` — dead/shadowed rules, unknown mesh
-   axes, indivisible shards. The ``tp``-diff mode additionally reports
-   the leaves the regex table cannot yet express vs the hand-built TP
-   assignment: the ROADMAP item-3 migration worklist (info severity —
-   reported, never failing).
-3. **jaxpr lint**: the tiny-config eval forward and full GAN train step
-   traced with ``jax.make_jaxpr`` over ``ShapeDtypeStruct`` args (no
-   device compute) and walked for host callbacks and f32 dot/conv leaks
-   under the declared bf16 policy.
+   shape-only via ``jax.eval_shape``. The facades family audits against
+   its PREDICATE-rule TP table (zero tp-diff gaps — drained); the
+   remaining families still diff against the replicated table, feeding
+   the ROADMAP item-3 worklist (info severity).
+5. **Memory audit** (analysis/memory_audit): donation markers on the
+   lowered train steps (a declared-donated leaf with no alias/donor
+   marker is copied, not donated), the serving dead-restore check, and —
+   with ``--memory-budget PATH`` — the per-config×mesh HBM budget table
+   written as a JSON artifact (CI uploads it).
+6. **jaxpr lint**: the traced-program set — tiny-config eval forward,
+   GAN train step (plus a sentinel-enabled variant exercising the
+   resolved-callback allow list), the video trainer step, and (given ≥2
+   devices) the pipelined ``build_pp_train_step`` program — walked for
+   host callbacks, f32 dot/conv leaks under the declared bf16 policy,
+   and collectives under ``lax.cond``.
 
 Waivers: ``# p2p-lint: disable=<rule> -- reason`` in source (findings
 carry eqn source locations, so even jaxpr findings waive in-source); the
-waiver COUNT is printed in the summary — CI logs it on every run.
+waiver COUNT is printed in the summary — CI logs it on every run, and
+tests pin a ceiling so it can only go down.
 
 Exit codes: 0 clean (waived-only), 1 unwaived findings, 2 analyzer crash.
 """
@@ -28,8 +42,21 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import sys
 import traceback
+
+
+def _ensure_fake_devices() -> None:
+    """Give the CPU platform 8 fake devices BEFORE jax initializes, so
+    the mesh-bearing traced programs (PP) lint everywhere the CLI runs.
+    A no-op when jax is already imported (tests set this in conftest)."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -45,8 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "table migration worklist (ROADMAP item 3), one "
                         "line per leaf")
     p.add_argument("--skip-jaxpr", action="store_true",
-                   help="skip the (slower) traced-program lint — AST + "
-                        "sharding audit only")
+                   help="skip the (slower) traced-program analyses — "
+                        "jaxpr walks AND the donation audit; AST + "
+                        "sharding + dead-restore (+ budget table) only")
+    p.add_argument("--memory-budget", type=str, default=None,
+                   dest="memory_budget", metavar="PATH",
+                   help="ALSO compute the per-config×mesh HBM budget "
+                        "table (trace-heavy, ~30 s) and write it to PATH "
+                        "as JSON — the CI artifact; its over-budget "
+                        "findings join the report")
     p.add_argument("--tp-axis-size", type=int, default=2,
                    help="hypothetical model-axis width for the tp diff")
     p.add_argument("--tp-min-ch", type=int, default=512,
@@ -54,14 +88,14 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _tiny_cfg():
-    """facades shrunk to trace-size: same code paths, seconds to trace."""
+def _tiny_cfg(preset: str = "facades", **model_kw):
+    """A preset shrunk to trace-size: same code paths, seconds to trace."""
     from p2p_tpu.core.config import get_preset
 
-    cfg = get_preset("facades")
+    cfg = get_preset(preset)
     return dataclasses.replace(
         cfg,
-        model=dataclasses.replace(cfg.model, ngf=8, ndf=8),
+        model=dataclasses.replace(cfg.model, ngf=8, ndf=8, **model_kw),
         data=dataclasses.replace(cfg.data, image_size=16, batch_size=2),
     )
 
@@ -73,26 +107,55 @@ def _sds_tree(tree):
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
 
 
+def _tiny_batch(cfg, frames: int = 0):
+    import jax
+    import numpy as np
+
+    bs, (h, w) = cfg.data.batch_size, cfg.image_hw
+    lead = (bs, frames) if frames else (bs,)
+    return {
+        "input": jax.ShapeDtypeStruct(
+            lead + (h, w, cfg.model.input_nc), np.uint8),
+        "target": jax.ShapeDtypeStruct(
+            lead + (h, w, cfg.model.output_nc), np.uint8),
+    }
+
+
+#: the sharding-audit preset set: the facades family audits (and diffs)
+#: against its predicate-rule TP table — zero gaps is the drained state —
+#: while the ResNet family still diffs against REPLICATED_RULES, feeding
+#: the item-3 worklist.
+AUDIT_PRESETS = ("facades", "facades_int8", "edges2shoes_dp",
+                 "cityscapes_spatial")
+
+
 def run_sharding_audit(report, tp_axis_size: int, tp_min_ch: int):
-    """Audit the repo's live rule tables against full-size preset states
-    (shape-only); returns the tp-diff worklist."""
+    """Audit each preset against ITS rule table (family TP tables where
+    drained, replicated elsewhere); returns the remaining tp-diff
+    worklist."""
     from p2p_tpu.analysis.sharding_audit import (
         abstract_train_state,
         audit_rules,
         tp_rule_gaps,
     )
     from p2p_tpu.core.config import get_preset
-    from p2p_tpu.parallel.rules import REPLICATED_RULES
+    from p2p_tpu.parallel.rules import (
+        REPLICATED_RULES,
+        tp_equivalence_rules,
+    )
 
     # the hypothetical target topology: every axis the mesh vocabulary
     # names, sized so divisibility is actually exercised (no devices)
     mesh = {"data": 8, "spatial": 2, "time": 1,
             "model": tp_axis_size, "pipe": 2}
     worklist = []
-    for preset in ("facades", "cityscapes_spatial"):
-        state = abstract_train_state(get_preset(preset))
-        report.extend(audit_rules(REPLICATED_RULES, state, mesh))
-        wl, findings = tp_rule_gaps(state, rules=REPLICATED_RULES,
+    for preset in AUDIT_PRESETS:
+        cfg = get_preset(preset)
+        rules = tp_equivalence_rules(cfg, tp_axis_size, tp_min_ch) \
+            or REPLICATED_RULES
+        state = abstract_train_state(cfg)
+        report.extend(audit_rules(rules, state, mesh))
+        wl, findings = tp_rule_gaps(state, rules=rules,
                                     axis_size=tp_axis_size,
                                     min_ch=tp_min_ch)
         for entry in wl:
@@ -102,63 +165,228 @@ def run_sharding_audit(report, tp_axis_size: int, tp_min_ch: int):
     return worklist
 
 
-def run_jaxpr_lint(report):
-    """Trace the eval forward and the full GAN train step of the tiny
-    config (abstract args — zero device compute) and walk them for host
-    callbacks and f32 leaks under the declared bf16 policy."""
+def _image_setup():
+    """(cfg, abstract state, abstract batch) for the tiny image trainer —
+    the ONE construction site shared by the traced analyses."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from p2p_tpu.train.state import create_train_state
+
+    cfg = _tiny_cfg()
+    batch = _tiny_batch(cfg)
+    ts = jax.eval_shape(lambda: create_train_state(
+        cfg, jax.random.key(0),
+        {k: np.zeros(v.shape, v.dtype) for k, v in batch.items()},
+        train_dtype=jnp.bfloat16))
+    return cfg, _sds_tree(ts), batch
+
+
+def _video_setup():
+    """The video-trainer twin of :func:`_image_setup`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.train.video_step import create_video_train_state
+
+    vcfg = _tiny_cfg("vid2vid_temporal")
+    vcfg = dataclasses.replace(
+        vcfg, data=dataclasses.replace(vcfg.data, batch_size=1, n_frames=2))
+    vbatch = _tiny_batch(vcfg, frames=2)
+    vs = jax.eval_shape(lambda: create_video_train_state(
+        vcfg, jax.random.key(0),
+        {k: np.zeros(v.shape, v.dtype) for k, v in vbatch.items()},
+        train_dtype=jnp.bfloat16))
+    return vcfg, _sds_tree(vs), vbatch
+
+
+def run_memory_audit(report, budget_path=None):
+    """The trace-free memory checks: the serving dead-restore audit and —
+    with ``budget_path`` — the HBM budget table (written as the JSON
+    artifact). The donation audit lives with the traced analyses
+    (:func:`run_traced_analyses`), where it shares each program's single
+    trace."""
+    from p2p_tpu.analysis.memory_audit import (
+        dead_restore_findings,
+        memory_budget_table,
+    )
+
+    report.extend(dead_restore_findings())
+
+    if budget_path:
+        import json
+
+        rows, findings = memory_budget_table()
+        report.extend(findings)
+        with open(budget_path, "w") as fh:
+            json.dump({"rows": rows}, fh, indent=2)
+        print(f"memory budget table: {len(rows)} config×mesh rows -> "
+              f"{budget_path}", file=sys.stderr)
+
+
+def _pp_program():
+    """The pipelined train step's jaxpr on a tiny 2-stage mesh, or None
+    when fewer than 2 devices are visible (the CLI forces 8 fake CPU
+    devices when it owns jax initialization)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        return None
+    from p2p_tpu.parallel.pp import pp_split_state
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_pp_train_step
+
+    cfg = _tiny_cfg("reference", n_blocks=4)
+    bs, (h, w) = cfg.data.batch_size, cfg.image_hw
+    sample = {
+        "input": np.zeros((bs, h, w, cfg.model.input_nc), np.uint8),
+        "target": np.zeros((bs, h, w, cfg.model.output_nc), np.uint8),
+    }
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                ("data", "pipe"))
+    # pp_split_state stacks + places the trunk: a (tiny) concrete state
+    state = create_train_state(cfg, jax.random.key(0), sample,
+                               train_dtype=jnp.bfloat16)
+    pp_state = pp_split_state(state, cfg, mesh)
+    step = build_pp_train_step(cfg, mesh, n_micro=2,
+                               train_dtype=jnp.bfloat16, jit=False)
+    batch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in sample.items()}
+    return jax.make_jaxpr(step)(_sds_tree(pp_state), batch)
+
+
+def run_traced_analyses(report):
+    """The traced-program analyses: jaxpr walks (host callbacks, f32
+    leaks under the declared bf16 policy, collectives under ``lax.cond``)
+    AND the donation-marker audit — each train-step program is traced
+    ONCE (``jit(...).trace``) and both the jaxpr and the lowering come
+    from that single trace."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.analysis.collective_consistency import (
+        collectives_under_cond,
+    )
     from p2p_tpu.analysis.findings import apply_pragma_waivers
     from p2p_tpu.analysis.jaxpr_lint import (
         f32_leak_findings,
         host_callback_findings,
     )
-    from p2p_tpu.train.state import create_infer_state, create_train_state
+    from p2p_tpu.analysis.memory_audit import donation_findings
+    from p2p_tpu.train.state import create_infer_state
     from p2p_tpu.train.step import build_train_step, make_infer_forward
 
-    cfg = _tiny_cfg()
-    bs, (h, w) = cfg.data.batch_size, cfg.image_hw
-    sample = {"input": np.zeros((bs, h, w, cfg.model.input_nc), np.uint8),
-              "target": np.zeros((bs, h, w, cfg.model.output_nc), np.uint8)}
-    batch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
-             for k, v in sample.items()}
-
     findings = []
+
+    def walk(jx, tag, allow=()):
+        findings.extend(host_callback_findings(jx, tag=tag, allow=allow))
+        findings.extend(f32_leak_findings(jx, tag=tag))
+        findings.extend(collectives_under_cond(jx, tag=tag))
+
+    cfg, sds, batch = _image_setup()
+    sample = {k: np.zeros(v.shape, v.dtype) for k, v in batch.items()}
+
     # eval/serving forward (metrics tail included — its f32 quality convs
     # are the known, pragma-waived island in losses/metrics.py)
     ist = jax.eval_shape(lambda: create_infer_state(
         cfg, jax.random.key(0), sample, jnp.bfloat16))
-    jx = jax.make_jaxpr(make_infer_forward(cfg, jnp.bfloat16))(
-        _sds_tree(ist), batch)
-    findings += host_callback_findings(jx, tag="eval_forward")
-    findings += f32_leak_findings(jx, tag="eval_forward")
+    walk(jax.make_jaxpr(make_infer_forward(cfg, jnp.bfloat16))(
+        _sds_tree(ist), batch), tag="eval_forward")
 
     # the full alternating-GAN train step (debug taps at their defaults:
-    # a host callback here would fence every training dispatch)
-    ts = jax.eval_shape(lambda: create_train_state(
-        cfg, jax.random.key(0), sample, train_dtype=jnp.bfloat16))
-    jx = jax.make_jaxpr(build_train_step(cfg, train_dtype=jnp.bfloat16,
-                                         jit=False))(_sds_tree(ts), batch)
-    findings += host_callback_findings(jx, tag="train_step")
-    findings += f32_leak_findings(jx, tag="train_step")
+    # a host callback here would fence every training dispatch) — ONE
+    # trace of the jitted, donating step serves walks AND donation audit
+    tr = build_train_step(cfg, train_dtype=jnp.bfloat16).trace(sds, batch)
+    walk(tr.jaxpr, tag="train_step")
+    report.extend(donation_findings(tr.lower().as_text(), sds,
+                                    tag="train_step", jaxpr=tr.jaxpr))
+
+    # the sentinel-enabled variant: the obs tap's debug_callback is the
+    # ONE sanctioned callback — allowed by its RESOLVED target function
+    # (obs/taps._on_counts through jax's flat-callback closure and one
+    # functools.partial level), so any OTHER callback still flags
+    scfg = dataclasses.replace(
+        cfg, debug=dataclasses.replace(cfg.debug, nan_sentinel=True))
+    walk(jax.make_jaxpr(build_train_step(scfg, train_dtype=jnp.bfloat16,
+                                         jit=False))(sds, batch),
+         tag="train_step+sentinel", allow=("_on_counts",))
+
+    # the video trainer step (satellite: trace-coverage gap — the video
+    # loop's hot path was previously unlinted); same shared-trace shape
+    from p2p_tpu.train.video_step import build_video_train_step
+
+    vcfg, vsds, vbatch = _video_setup()
+    vtr = build_video_train_step(
+        vcfg, train_dtype=jnp.bfloat16).trace(vsds, vbatch)
+    walk(vtr.jaxpr, tag="video_train_step")
+    report.extend(donation_findings(vtr.lower().as_text(), vsds,
+                                    tag="video_train_step",
+                                    jaxpr=vtr.jaxpr))
+
+    # the pipelined program (needs >= 2 devices for a real pipe axis)
+    pp = _pp_program()
+    if pp is not None:
+        walk(pp, tag="pp_train_step")
+    else:
+        print("lint: skipping pp_train_step trace (<2 devices — run with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              file=sys.stderr)
 
     report.extend(apply_pragma_waivers(findings))
 
 
+def run_ast_passes(report):
+    """The three AST-family analyzers over ONE package walk and ONE
+    parse per module (each lint_package_* entry point re-walks on its
+    own — fine for tests, 3× the IO/parse cost for the gate)."""
+    import ast
+
+    from p2p_tpu.analysis.ast_rules import lint_source
+    from p2p_tpu.analysis.collective_consistency import (
+        lint_collective_source,
+    )
+    from p2p_tpu.analysis.concurrency_lint import lint_concurrency_source
+    from p2p_tpu.analysis.findings import (
+        ERROR,
+        Finding,
+        iter_package_sources,
+    )
+
+    for rel, text, err in iter_package_sources():
+        if text is None:
+            report.add(Finding(rule="ast-unreadable", severity=ERROR,
+                               file=rel, message=str(err)))
+            continue
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            report.extend(lint_source(rel, text))  # emits ast-syntax-error
+            continue
+        report.extend(lint_source(rel, text, tree=tree))
+        report.extend(lint_collective_source(rel, text, tree=tree))
+        report.extend(lint_concurrency_source(rel, text, tree=tree))
+
+
 def main(argv=None) -> int:
+    _ensure_fake_devices()
     args = build_parser().parse_args(argv)
 
-    from p2p_tpu.analysis.ast_rules import lint_package
     from p2p_tpu.analysis.findings import Report
 
     try:
-        report = lint_package()
+        report = Report()
+        run_ast_passes(report)
         worklist = run_sharding_audit(report, args.tp_axis_size,
                                       args.tp_min_ch)
+        run_memory_audit(report, budget_path=args.memory_budget)
         if not args.skip_jaxpr:
-            run_jaxpr_lint(report)
+            run_traced_analyses(report)
     except Exception:
         traceback.print_exc()
         print("lint: analyzer crashed (exit 2)", file=sys.stderr)

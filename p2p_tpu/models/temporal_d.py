@@ -83,6 +83,7 @@ class _SplitTimeStem(nn.Module):
             xs = xp[:, dt:dt + t].reshape(n * t, h, w, c).astype(jnp.float32)
             dn = jax.lax.conv_dimension_numbers(
                 xs.shape, kernel.shape[1:], ("NHWC", "HWIO", "NHWC"))
+            # p2p-lint: disable=jaxpr-f32-leak -- deliberate (docstring above): fully-f32 taps match the 3-D conv's round-once f32 accumulation; preferred_element_type on bf16 operands breaks the autodiff transpose, and the thin stem's FLOPs are trivial
             part = jax.lax.conv_general_dilated(
                 xs, kernel[dt], (s, s), ((2, 2), (2, 2)),
                 dimension_numbers=dn,

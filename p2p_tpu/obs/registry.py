@@ -248,16 +248,24 @@ class MetricsRegistry:
         return self._get(EWMARate, name, tags, halflife_s=halflife_s)
 
     # -- record bus --------------------------------------------------------
+    # The sink list is mutated from setup/teardown code while records fan
+    # out from OTHER threads (the in-jit sentinel callbacks, the signal
+    # guard's flush helper): mutations hold the registry lock and every
+    # fan-out iterates a snapshot, so a sink attached mid-record can never
+    # corrupt the iteration (conc-unlocked-shared-mutation).
     def add_sink(self, sink) -> None:
-        self._sinks.append(sink)
+        with self._lock:
+            self._sinks.append(sink)
 
     def remove_sink(self, sink) -> None:
-        if sink in self._sinks:
-            self._sinks.remove(sink)
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
 
     @property
     def sinks(self):
-        return tuple(self._sinks)
+        with self._lock:
+            return tuple(self._sinks)
 
     def record(self, payload: Dict[str, Any], force: bool = False) -> None:
         """Fan a structured record (a flat dict with a ``kind`` field, e.g.
@@ -270,15 +278,15 @@ class MetricsRegistry:
             for k, v in payload.items()
         }
         rec.setdefault("ts", round(time.time(), 3))
-        for s in self._sinks:
+        for s in self.sinks:   # snapshot: add/remove race-free
             s.write(rec, force=force)
 
     def flush(self) -> None:
-        for s in self._sinks:
+        for s in self.sinks:
             s.flush()
 
     def close(self) -> None:
-        for s in self._sinks:
+        for s in self.sinks:
             s.close()
 
     # -- snapshots & cross-host aggregation --------------------------------

@@ -179,8 +179,13 @@ class PrometheusTextfileSink(Sink):
         self._closed = False
 
     def write(self, rec: Dict[str, Any], force: bool = False) -> None:
-        self._n += 1
-        if force or self._n % self.export_every == 0:
+        # counted under the lock (records arrive from any thread; a bare
+        # += would lose updates), exported OUTSIDE it — export() takes
+        # the same non-reentrant lock for the atomic rename
+        with self._lock:
+            self._n += 1
+            n = self._n
+        if force or n % self.export_every == 0:
             self.export()
 
     def export(self) -> None:

@@ -7,6 +7,17 @@ against slash-joined leaf paths — produces the PartitionSpec tree for an
 arbitrary pytree (params, optimizer moments, or a whole TrainState; adam's
 mu/nu mirror the param paths, so one param rule covers all three).
 
+**Predicate rules** (the item-3 migration mechanism): a rule may carry a
+third element, ``predicate(shape) -> bool`` — the rule fires only when its
+regex matches AND the predicate accepts the leaf shape. This is exactly
+the expressive gap the tp-diff worklist names ``needs-predicate-rule``:
+the hand-built TP assignment (parallel/tp.py) gates every shard on
+channel width and divisibility, which a bare regex cannot see.
+:func:`make_unet_tp_rules` / :func:`make_patchgan_tp_rules` use it to
+reproduce ``tp_leaf_spec`` declaratively for the facades (U-Net +
+PatchGAN) family — the first family drained from the worklist; the
+ResNet/pix2pixHD trunks are the remaining entries.
+
 First consumer: the elastic resharded-resume path (train/loop.py
 ``plan_elastic_restore``). A relaunch on a different slice derives the
 checkpoint's **target shardings for the NEW mesh** from rules instead of
@@ -22,7 +33,7 @@ the snippets agree on.
 from __future__ import annotations
 
 import re
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -30,8 +41,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2p_tpu.core.mesh import MODEL_AXIS
 
-#: (regex, PartitionSpec) pairs, first match wins (re.search semantics).
-Rules = Sequence[Tuple[str, P]]
+#: ``(regex, PartitionSpec)`` or ``(regex, PartitionSpec, predicate)``
+#: entries, first match wins (re.search semantics; a predicate rule only
+#: matches when ``predicate(shape)`` is also true).
+Rules = Sequence[Tuple]
+
+ShapePredicate = Callable[[Tuple[int, ...]], bool]
+
+
+def rule_parts(rule) -> Tuple[str, P, Optional[ShapePredicate]]:
+    """Normalize a 2- or 3-tuple rule entry to ``(pattern, spec, pred)``."""
+    if len(rule) == 2:
+        return rule[0], rule[1], None
+    pat, spec, pred = rule
+    return pat, spec, pred
 
 #: The baseline table: fully-replicated state — correct for DP and for
 #: every mesh whose extra axes (spatial/time/pipe) shard activations, not
@@ -73,10 +96,13 @@ def match_partition_rules(rules: Rules, tree: Any):
         shape = np.shape(leaf) if not hasattr(leaf, "shape") else leaf.shape
         if len(shape) == 0 or int(np.prod(shape)) == 1:
             return P()  # never partition scalars
-        for rule, ps in rules:
-            if re.search(rule, name) is not None:
+        for rule in rules:
+            pat, ps, pred = rule_parts(rule)
+            if re.search(pat, name) is not None \
+                    and (pred is None or pred(tuple(shape))):
                 return ps
-        tried = "; ".join(f"[{i}] {pat!r}" for i, (pat, _) in enumerate(rules))
+        tried = "; ".join(f"[{i}] {rule_parts(r)[0]!r}"
+                          for i, r in enumerate(rules))
         raise ValueError(f"no partition rule matched leaf {name!r} "
                          f"(shape {tuple(shape)}); tried "
                          f"{tried or '<empty table>'} — add a catch-all "
@@ -106,3 +132,85 @@ def state_target_shardings(state: Any, mesh: Mesh,
     specs = match_partition_rules(rules, state)
     return jax.tree_util.tree_map(lambda ps: NamedSharding(mesh, ps), specs,
                                   is_leaf=lambda x: isinstance(x, P))
+
+
+# ---------------------------------------------------------------------------
+# Family TP tables — predicate rules reproducing parallel/tp.tp_leaf_spec
+# declaratively, family by family (the item-3 worklist drain).
+# ---------------------------------------------------------------------------
+
+_OUT_K = P(None, None, None, MODEL_AXIS)   # conv kernel, C_out sharded
+_IN_K = P(None, None, MODEL_AXIS, None)    # conv kernel, C_in sharded
+_OUT_B = P(MODEL_AXIS)                     # bias riding a sharded C_out
+
+
+def _gate_out(axis_size: int, min_ch: int) -> ShapePredicate:
+    return lambda s: (len(s) == 4 and s[3] >= min_ch
+                      and s[3] % axis_size == 0)
+
+
+def _gate_in(axis_size: int, min_ch: int) -> ShapePredicate:
+    return lambda s: (len(s) == 4 and s[2] >= min_ch
+                      and s[2] % axis_size == 0)
+
+
+def _gate_bias(axis_size: int, min_ch: int) -> ShapePredicate:
+    return lambda s: (len(s) == 1 and s[0] >= min_ch
+                      and s[0] % axis_size == 0)
+
+
+def _log2_odd(n: int) -> bool:
+    # exact power of two with odd exponent — the PatchGAN chain parity key
+    return n > 0 and (n & (n - 1)) == 0 and (n.bit_length() - 1) % 2 == 1
+
+
+def make_unet_tp_rules(axis_size: int = 2, min_ch: int = 512) -> Tuple:
+    """The U-Net generator's Megatron pairs as predicate rules: (down3 →
+    down4) and the bottleneck (down5 → up5), kernels only (the U-Net down
+    convs carry no bias — BatchNorm absorbs it). Width/divisibility gates
+    mirror :func:`p2p_tpu.parallel.tp.tp_leaf_spec` exactly."""
+    out, inn = _gate_out(axis_size, min_ch), _gate_in(axis_size, min_ch)
+    return (
+        (r"down3/kernel$", _OUT_K, out),
+        (r"down4/kernel$", _IN_K, inn),
+        (r"down5/kernel$", _OUT_K, out),
+        (r"up5/kernel$", _IN_K, inn),
+    )
+
+
+def make_patchgan_tp_rules(axis_size: int = 2, min_ch: int = 512) -> Tuple:
+    """The PatchGAN discriminator chains as predicate rules. The conv
+    names differ per preset (``_PlainConv_k`` / ``SpectralConv_k``), so
+    the rules key on the channel-doubling chain's log2-parity — the same
+    shape law ``tp_leaf_spec`` applies: an odd-power C_in in-shards (with
+    one psum), an odd-power C_out out-shards, gates replicate the rest.
+    The bare in-parity rule (no gate) BLOCKS a gate-failed in-parity
+    kernel from falling through to the out rule — precedence mirrors
+    ``_tp_spec`` checking C_in first."""
+    out, inn = _gate_out(axis_size, min_ch), _gate_in(axis_size, min_ch)
+    bias = _gate_bias(axis_size, min_ch)
+    return (
+        (r"scale\d+/.*/kernel$", _IN_K,
+         lambda s: len(s) == 4 and _log2_odd(s[2]) and inn(s)),
+        (r"scale\d+/.*/kernel$", P(),
+         lambda s: len(s) == 4 and _log2_odd(s[2])),
+        (r"scale\d+/.*/kernel$", _OUT_K,
+         lambda s: len(s) == 4 and _log2_odd(s[3]) and out(s)),
+        (r"scale\d+/.*/bias$", _OUT_B,
+         lambda s: len(s) == 1 and _log2_odd(s[0]) and bias(s)),
+    )
+
+
+def tp_equivalence_rules(cfg, axis_size: int = 2,
+                         min_ch: int = 512) -> Optional[Rules]:
+    """The declarative table reproducing ``tp_leaf_spec`` for ``cfg``'s
+    model family, or None while the family still needs predicate rules
+    (the remaining tp-diff worklist). Drained so far: the facades family
+    (U-Net generator + PatchGAN discriminators — facades / facades_int8 /
+    edges2shoes_dp). The ResNet/pix2pixHD trunk families stay on
+    :data:`REPLICATED_RULES` until their pair rules land here."""
+    if cfg.model.generator == "unet":
+        return (make_unet_tp_rules(axis_size, min_ch)
+                + make_patchgan_tp_rules(axis_size, min_ch)
+                + ((r".*", P()),))
+    return None

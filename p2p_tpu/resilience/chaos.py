@@ -77,6 +77,18 @@ class SeamSpec:
 
 _ENTRY_RE = None  # compiled lazily (module import stays re-free)
 
+#: Seams that short-circuit a cross-host agreement protocol and therefore
+#: MUST fire on every host at the same step: the ``elastic`` seam converts
+#: straight into ``PreemptionGuard.request`` + an immediate stop WITHOUT
+#: the allgather cadence (train/loop.py poll_preempt) — that is only safe
+#: because a step-pinned ``elastic@N`` fires on every host's Nth dispatch.
+#: A probabilistic ``elastic:p`` draws from each process's own RNG stream
+#: (whose position depends on that host's other seam traffic), so one host
+#: would stop while the rest march into the next agreement collective and
+#: hang — the exact bug class the collective-consistency lint exists for
+#: (p2p_tpu/analysis/collective_consistency.py).
+_STEP_PINNED_SEAMS = frozenset({"elastic"})
+
 
 def parse_spec(spec: str) -> Dict[str, SeamSpec]:
     """Parse the spec grammar above into ``{seam: SeamSpec}``."""
@@ -99,6 +111,13 @@ def parse_spec(spec: str) -> Dict[str, SeamSpec]:
             raise ValueError(f"bad chaos entry {entry!r}")
         seam = m.group("seam").strip()
         cap = int(m.group("cap")) if m.group("cap") else None
+        if seam in _STEP_PINNED_SEAMS and m.group("step") is None:
+            raise ValueError(
+                f"chaos seam {seam!r} must be step-pinned (use "
+                f"'{seam}@N' or '{seam}@NxM'): a probabilistic spec "
+                "fires on a per-host RNG draw, so one host preempts "
+                "while the others hang in the next agreement collective "
+                f"(bad entry: {entry!r})")
         if m.group("step") is not None:
             out[seam] = SeamSpec(at_step=int(m.group("step")),
                                  max_faults=cap if cap else 1)

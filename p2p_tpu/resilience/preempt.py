@@ -86,8 +86,11 @@ class PreemptionGuard:
         """Run ``fn`` (e.g. ``registry.flush``) inside the signal handler —
         buffered telemetry survives even a run that dies in its grace
         window. Hooks must be quick and exception-safe-ish; errors are
-        swallowed (a broken flush must not eat the preemption flag)."""
-        self._flush_hooks.append(fn)
+        swallowed (a broken flush must not eat the preemption flag).
+        Locked: the flush helper thread snapshots this list while the
+        main thread may still be registering hooks."""
+        with self._lock:
+            self._flush_hooks.append(fn)
 
     def install(self) -> "PreemptionGuard":
         """Install SIGTERM/SIGINT handlers (main thread only — signal.signal
@@ -95,6 +98,7 @@ class PreemptionGuard:
         if self._installed:
             return self
         for s in self.SIGNALS:
+            # p2p-lint: disable=conc-unlocked-shared-mutation -- install/uninstall are main-thread only (signal.signal raises elsewhere), and the handler reading _old runs ON the main thread between bytecodes — one thread, no race
             self._old[s] = signal.signal(s, self._handler)
         self._installed = True
         return self
@@ -108,6 +112,7 @@ class PreemptionGuard:
                 signal.signal(s, old)
             except (ValueError, TypeError):
                 pass
+        # p2p-lint: disable=conc-unlocked-shared-mutation -- main-thread only, see install()
         self._old.clear()
         self._installed = False
 
@@ -146,7 +151,9 @@ class PreemptionGuard:
                 signal=signal.Signals(signum).name).inc()
         except Exception:
             pass
-        for fn in self._flush_hooks:
+        with self._lock:
+            hooks = list(self._flush_hooks)
+        for fn in hooks:
             try:
                 fn()
             except Exception:
@@ -181,12 +188,14 @@ class PreemptionGuard:
 
         if jax.process_count() == 1:
             return self._requested
+        # p2p-lint: disable=conc-unlocked-shared-mutation -- polled from the train loop's dispatch thread only; the signal path never touches the counter
         self._polls += 1
         if self._polls % self.sync_every:
             return False
         import numpy as np
         from jax.experimental import multihost_utils
 
+        # p2p-lint: disable=collective-after-divergent-exit -- the poll counter IS aligned by contract: every host calls should_stop exactly once per dispatch (equal batch counts per host), so the modulo cadence admits/skips the allgather on ALL hosts together
         flags = np.asarray(multihost_utils.process_allgather(
             np.array([1 if self._requested else 0], np.int32)))
         agreed = bool(flags.any())

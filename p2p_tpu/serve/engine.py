@@ -304,6 +304,29 @@ class InferenceEngine:
         return stats, out_metrics
 
 
+def serving_restore_template(cfg: Config,
+                             sample_batch: Dict[str, np.ndarray]):
+    """The InferState template the serving restore actually reads.
+
+    Template dtype stays None (f32 masters): the checkpoint stores f32
+    state and the dtype POLICY is compute-side (make_infer_forward casts)
+    — exactly the trainer's mixed-precision stance.
+
+    With EMA serving (``cfg.health.ema_decay`` set), the template keeps
+    ONLY the smoothed tree: the engine swaps ``ema_g`` into ``params_g``
+    immediately after restore, so also reading ``params_g`` from disk
+    would double the generator restore bytes (and hold both trees in
+    memory) just to discard one — the ``memory-dead-restore`` finding the
+    static-analysis gate pins (p2p_tpu/analysis/memory_audit.py). The
+    same helper feeds that auditor, so the two cannot drift."""
+    from p2p_tpu.train.state import create_infer_state
+
+    template = create_infer_state(cfg, jax.random.key(0), sample_batch)
+    if jax.tree_util.tree_leaves(template.ema_g):
+        template = template.replace(params_g=None)
+    return template
+
+
 def engine_from_checkpoint(
     cfg: Config,
     ckpt_dir: str,
@@ -315,17 +338,13 @@ def engine_from_checkpoint(
     construction path of cli/infer.py and cli/serve.py. Returns
     ``(engine, restored_step)``."""
     from p2p_tpu.train.checkpoint import CheckpointManager
-    from p2p_tpu.train.state import create_infer_state
 
     mgr = CheckpointManager(ckpt_dir)
     try:
         step = step if step is not None else mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {ckpt_dir}")
-        # template dtype stays None (f32 masters): the checkpoint stores
-        # f32 state and the dtype POLICY is compute-side (make_infer_
-        # forward casts) — exactly the trainer's mixed-precision stance
-        template = create_infer_state(cfg, jax.random.key(0), sample_batch)
+        template = serving_restore_template(cfg, sample_batch)
         state = mgr.restore_subtree(template, step)
     finally:
         mgr.close()
@@ -333,5 +352,7 @@ def engine_from_checkpoint(
         # EMA-trained checkpoint (HealthConfig.ema_decay, requested via
         # the CLI's --ema_decay): serve the SMOOTHED generator — the
         # ProGAN-lineage quality lever. Pinned bitwise == raw at decay=0.
+        # The template pruned params_g (serving_restore_template), so the
+        # raw tree was never read from disk.
         state = state.replace(params_g=state.ema_g, ema_g=None)
     return InferenceEngine(cfg, state, **engine_kw), int(step)

@@ -165,6 +165,11 @@ class AsyncImageWriter:
             self.encode_sec += dt
 
     def _prune_done(self) -> None:
+        # _futures is touched by the ONE dispatch thread only (submit_batch
+        # / drain callers); worker threads never see it — the conc lint
+        # waivers below document that contract (locking drain would
+        # deadlock: drain blocks on f.result() while workers need _lock
+        # for their counters).
         alive = []
         for f in self._futures:
             if f.done():
@@ -173,6 +178,7 @@ class AsyncImageWriter:
                     self._error = exc
             else:
                 alive.append(f)
+        # p2p-lint: disable=conc-unlocked-shared-mutation -- single dispatch thread by contract (see _prune_done comment)
         self._futures = alive
 
     def submit_batch(self, pred: Any, paths: Sequence[str]) -> None:
@@ -183,6 +189,7 @@ class AsyncImageWriter:
         while len(self._futures) >= self.max_pending:
             self._futures[0].result()   # throttle on the oldest batch
             self._prune_done()
+        # p2p-lint: disable=conc-unlocked-shared-mutation -- single dispatch thread by contract (see _prune_done comment)
         self._futures.append(
             self._pool.submit(self._write_batch, pred, list(paths)))
 
@@ -192,6 +199,7 @@ class AsyncImageWriter:
         number written."""
         for f in self._futures:
             f.result()
+        # p2p-lint: disable=conc-unlocked-shared-mutation -- single dispatch thread by contract (see _prune_done comment)
         self._futures.clear()
         if self._error is not None:
             err, self._error = self._error, None

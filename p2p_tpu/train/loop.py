@@ -412,6 +412,7 @@ def poll_preempt(tr) -> bool:
         # the agreed path below.
         tr.preempt.request(signal.SIGTERM)
         return True
+    # p2p-lint: disable=collective-after-divergent-exit -- both early exits are host-uniform: the guard is acquired on every host together (acquire_preempt_guard in fit), and the elastic seam is VALIDATED step-pinned (chaos.py rejects probabilistic 'elastic' specs), so FaultInjected fires on every host's same dispatch
     return tr.preempt.should_stop()
 
 
@@ -557,11 +558,13 @@ def perform_rollback(tr) -> None:
     tr._pending_health = None
     tr._host_step = int(target)
     tr.health.after_rollback(cur_step, int(target))
-    # the restore overwrote lr_scale with the checkpoint's value — resync
-    # the host cache so apply_health_lr compares against reality
-    # p2p-lint: disable=ast-host-sync-hot-loop -- rollback path only (rung 3 of the recovery ladder), never the per-step path
-    tr._applied_lr_scale = float(np.asarray(jax.device_get(
-        tr.state.lr_scale)))
+    # the restore overwrote the device lr_scale with the checkpoint's
+    # value; rather than fetching it back (a host sync, formerly waived
+    # under ast-host-sync-hot-loop), mark the host cache UNKNOWN — NaN
+    # compares unequal to any product, so apply_health_lr below writes
+    # the host-known (plateau × cooldown) scale unconditionally. One
+    # extra scalar write on a path that runs at most max_rollbacks times.
+    tr._applied_lr_scale = float("nan")
     apply_health_lr(tr)  # post-rollback cooldown engages immediately
     tr.logger.log(
         {"kind": "rollback", "step": int(cur_step),
@@ -1184,6 +1187,7 @@ class Trainer:
             # collective stays aligned), fronted by the `elastic` chaos
             # seam. The flag is only SET here; fit() owns the
             # save-and-exit policy.
+            # p2p-lint: disable=collective-after-divergent-exit -- the rollback break above is host-uniform: the ladder consumes device-REPLICATED metrics (identical float conversions on every host), so rollback_pending flips on the same dispatch everywhere
             if poll_preempt(self):
                 self._preempted = True
                 break
@@ -1342,10 +1346,13 @@ class Trainer:
         history = []
         armed_retrace = False  # armed after the first COMPLETED epoch
         self._preempted = False
-        # host mirror of the device step counter (the health path must
-        # never fetch state.step mid-epoch) — one scalar fetch per fit()
-        # p2p-lint: disable=ast-host-sync-hot-loop -- one scalar fetch per fit(), before the loop starts
-        self._host_step = int(np.asarray(jax.device_get(self.state.step)))
+        # the host mirror of the device step counter needs NO fetch here:
+        # it is maintained at every point the step can move — 0 at
+        # construction (init_trainer_health), the restored step in
+        # maybe_resume, the rollback target in perform_rollback, +k per
+        # dispatch (queue_health_observation) — so fit() starts aligned.
+        # (Was a jax.device_get waived under ast-host-sync-hot-loop; the
+        # waiver-ceiling pin in tests/test_analysis.py holds the count.)
         owned_guard = acquire_preempt_guard(self)
         try:
             while self.epoch <= nepoch:

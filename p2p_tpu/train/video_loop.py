@@ -344,6 +344,7 @@ class VideoTrainer:
                 break
             # preemption poll at the step boundary, fronted by the
             # `elastic` chaos seam (cf. Trainer.train_epoch)
+            # p2p-lint: disable=collective-after-divergent-exit -- the rollback break above is host-uniform: the ladder consumes device-replicated metrics (cf. Trainer.train_epoch's identical waiver)
             if poll_preempt(self):
                 self._preempted = True
                 break
@@ -435,9 +436,8 @@ class VideoTrainer:
         # preemption guard (p2p_tpu.resilience) — same protocol as the
         # image Trainer: flag at the signal, exact-step save + Preempted
         # at the next step boundary, exact-step resume via maybe_resume's
-        # skip_batches path.
-        # p2p-lint: disable=ast-host-sync-hot-loop -- one scalar fetch per fit(), before the loop starts
-        self._host_step = int(np.asarray(jax.device_get(self.state.step)))
+        # skip_batches path. The host step mirror is maintained (cf.
+        # Trainer.fit) — no device fetch needed here.
         owned_guard = acquire_preempt_guard(self)
         try:
             while self.epoch <= nepoch:

@@ -1,17 +1,28 @@
-"""p2p_tpu/analysis — the static-analysis subsystem (ISSUE 8).
+"""p2p_tpu/analysis — the static-analysis subsystem (ISSUEs 8 + 9).
 
-Covers all three analyzers plus the findings/pragma plumbing:
+Covers all six analyzers plus the findings/pragma plumbing:
 
 - sharding audit: synthetic trees with dead / shadowed / unknown-axis /
   indivisible / rank-overflow rules, the catch-all exemption, the scalar
-  floor, and the tp-diff migration worklist (synthetic + the real facades
-  preset — the ROADMAP item-3 acceptance pin);
+  floor, predicate rules, and the tp-diff migration worklist (synthetic +
+  the real facades preset; the facades family now DRAINS against its
+  predicate-rule table — the first item-3 bite);
 - jaxpr lint: a known-collective jaxpr fixture (shard_map psum/ppermute),
   HLO-text census, the activation-gather bound, scan-carry ppermute
-  flags, host-callback and f32-leak detectors (with source locations);
+  flags, host-callback (with partial resolution + allow-by-target) and
+  f32-leak detectors (with source locations);
+- collective consistency: divergent-predicate / divergent-exit / except-
+  handler fixtures, the uniform-predicate whitelist, cond-collective
+  jaxpr rule, and the repo-wide clean-or-waived pin;
+- memory audit: donation-marker parsing on lowered programs (defeated /
+  missing / clean), liveness peak, the budget table, and the serving
+  dead-restore check (incl. the EMA template-prune pin);
+- concurrency lint: signal-handler-lock, unlocked-shared-mutation and
+  atexit-join fixtures, and the repo-wide clean-or-waived pin;
 - AST rules: fixtures for each rule, including the waiver-pragma path;
 - the CLI gate: ``python -m p2p_tpu.cli.lint --strict`` is clean on this
-  repo and its tp-diff worklist is non-empty.
+  repo, its tp-diff worklist is non-empty, and the waiver count is held
+  under a pinned ceiling (it may only go DOWN).
 """
 
 import numpy as np
@@ -493,9 +504,19 @@ def test_tp_leaf_spec_public_helper():
 # ------------------------------------------------------- the CLI gate
 
 
+# PR 8 started at 18 waivers; this PR re-audited them (three device_get
+# waivers became real fixes) and added the three new analyzers' documented
+# waivers. The ceiling only ever moves DOWN: converting a waiver into a
+# fix lowers it, adding one without touching this number fails CI.
+WAIVER_CEILING = 26
+
+
 def test_lint_cli_strict_is_clean_on_this_repo(capsys):
     """THE standing gate: zero unwaived findings over the live repo, with
-    the waiver count reported and a non-empty item-3 worklist."""
+    the waiver count reported, under its pinned ceiling, and a non-empty
+    item-3 worklist."""
+    import re
+
     from p2p_tpu.cli.lint import main
 
     rc = main(["--strict", "--tp-diff"])
@@ -505,6 +526,16 @@ def test_lint_cli_strict_is_clean_on_this_repo(capsys):
     assert "waiver(s) carried with reasons" in out
     assert "tp-diff migration worklist" in out
     assert "needs-predicate-rule" in out      # non-empty worklist lines
+    # facades family drained: every remaining worklist line is another
+    # family's (the ResNet/pix2pixHD discriminator chains)
+    assert "[facades]" not in out
+    m = re.search(r"— 0 unwaived findings, (\d+) waiver", out)
+    assert m, out
+    assert int(m.group(1)) <= WAIVER_CEILING, (
+        f"waiver count {m.group(1)} exceeds the pinned ceiling "
+        f"{WAIVER_CEILING}: waivers may only ever DECREASE — fix the "
+        "finding, or (for a genuinely safe site) lower other waivers "
+        "first")
 
 
 def test_lint_cli_json_format(capsys):
@@ -522,3 +553,700 @@ def test_lint_cli_json_format(capsys):
     wl = payload["tp_worklist"]
     assert wl and {"leaf", "shape", "tp_spec", "rule_spec", "direction",
                    "preset"} <= set(wl[0])
+
+
+# --------------------------------------------- predicate rules (item 3)
+
+
+def test_predicate_rule_gates_match():
+    from p2p_tpu.parallel.rules import match_partition_rules
+
+    wide = lambda s: s[-1] >= 512          # noqa: E731
+    rules = ((r"kernel$", P(None, "model"), wide), (r".*", P()))
+    specs = match_partition_rules(rules, {
+        "a": {"kernel": np.zeros((4, 512))},
+        "b": {"kernel": np.zeros((4, 64))},     # gate fails -> catch-all
+    })
+    assert specs["a"]["kernel"] == P(None, "model")
+    assert specs["b"]["kernel"] == P()
+
+
+def test_audit_rules_respects_predicates():
+    from p2p_tpu.analysis.sharding_audit import audit_rules
+
+    wide = lambda s: s[-1] >= 512          # noqa: E731
+    rules = ((r"kernel$", P(None, "model"), wide), (r".*", P()))
+    tree = {"a": {"kernel": np.zeros((4, 512))},
+            "b": {"kernel": np.zeros((4, 64))}}
+    assert audit_rules(rules, tree, {"data": 2, "model": 4}) == []
+    # a predicate that never passes makes the rule DEAD, not shadowed
+    never = ((r"kernel$", P(None, "model"), lambda s: False), (r".*", P()))
+    (f,) = audit_rules(never, tree, {"data": 2, "model": 4})
+    assert f.rule == "sharding-dead-rule"
+
+
+def test_facades_family_tp_worklist_drained():
+    """Satellite 1's acceptance pin: the facades family's predicate-rule
+    table reproduces tp_leaf_spec EXACTLY — zero tp-diff gaps and a clean
+    audit for every U-Net preset; the ResNet family still has gaps (the
+    remaining item-3 worklist)."""
+    from p2p_tpu.analysis.sharding_audit import (
+        abstract_train_state,
+        audit_rules,
+        tp_rule_gaps,
+    )
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.parallel.rules import (
+        REPLICATED_RULES,
+        tp_equivalence_rules,
+    )
+
+    mesh = {"data": 8, "spatial": 2, "time": 1, "model": 2, "pipe": 2}
+    for preset in ("facades", "facades_int8", "edges2shoes_dp"):
+        cfg = get_preset(preset)
+        rules = tp_equivalence_rules(cfg, 2, 512)
+        assert rules is not None, preset
+        state = abstract_train_state(cfg)
+        assert audit_rules(rules, state, mesh) == [], preset
+        wl, gaps = tp_rule_gaps(state, rules=rules, axis_size=2, min_ch=512)
+        assert wl == [] and gaps == [], (preset, wl[:3])
+    # the remaining worklist: cityscapes' family has no table yet
+    cfg = get_preset("cityscapes_spatial")
+    assert tp_equivalence_rules(cfg) is None
+    wl, _ = tp_rule_gaps(abstract_train_state(cfg),
+                         rules=REPLICATED_RULES, axis_size=2, min_ch=512)
+    assert wl      # non-empty until its predicate rules land
+
+
+# ------------------------------------------- collective consistency (a)
+
+
+def _clint(relpath, src):
+    from p2p_tpu.analysis.collective_consistency import (
+        lint_collective_source,
+    )
+
+    return lint_collective_source(relpath, src)
+
+
+def test_collective_divergent_branch_fixture():
+    src = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "def f(self, healthy):\n"
+        "    if healthy:\n"
+        "        multihost_utils.process_allgather(1)\n"
+    )
+    (f,) = _clint("train/foo.py", src)
+    assert f.rule == "collective-divergent-branch" and f.severity == ERROR
+    assert "process_allgather" in f.message and f.line == 5
+
+
+def test_collective_in_except_handler_fixture():
+    src = (
+        "from jax.experimental import multihost_utils\n"
+        "def f():\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except Exception:\n"
+        "        multihost_utils.sync_global_devices('recover')\n"
+    )
+    (f,) = _clint("resilience/foo.py", src)
+    assert f.rule == "collective-divergent-branch"
+    assert "except handler" in f.message
+
+
+def test_collective_after_divergent_exit_fixture():
+    src = (
+        "from jax.experimental import multihost_utils\n"
+        "def f(self):\n"
+        "    if self.flag:\n"
+        "        return False\n"
+        "    return multihost_utils.process_allgather(1)\n"
+    )
+    (f,) = _clint("train/foo.py", src)
+    assert f.rule == "collective-after-divergent-exit"
+    assert "line 4" in f.message
+
+
+def test_collective_nested_def_is_not_a_call():
+    """Defining a helper inside a divergent branch is not calling it —
+    the helper's body gets its own pass (where the collective at its
+    top level is unconditional, hence clean)."""
+    src = (
+        "from jax.experimental import multihost_utils\n"
+        "def outer(flag):\n"
+        "    if flag:\n"
+        "        def helper():\n"
+        "            return multihost_utils.process_allgather(1)\n"
+        "        return helper\n"
+    )
+    assert _clint("train/foo.py", src) == []
+
+
+def test_collective_uniform_predicates_are_clean():
+    # process_count comparisons — direct and through a local name — are
+    # host-uniform; process_index is NOT
+    src = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "def ok():\n"
+        "    if jax.process_count() == 1:\n"
+        "        return None\n"
+        "    n = jax.process_count()\n"
+        "    if n > 1:\n"
+        "        multihost_utils.process_allgather(1)\n"
+    )
+    assert _clint("train/foo.py", src) == []
+    bad = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "def f():\n"
+        "    if jax.process_index() == 0:\n"
+        "        multihost_utils.process_allgather(1)\n"
+    )
+    (f,) = _clint("train/foo.py", bad)
+    assert f.rule == "collective-divergent-branch"
+
+
+def test_collective_uniform_chain_survives_fixpoint():
+    """Regression: uniform-from-uniform chains (``world = n`` after
+    ``n = jax.process_count()``) must stay uniform — the optimistic
+    fixpoint recovers the chain instead of tainting it on the first
+    pass."""
+    chain = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "def f():\n"
+        "    n = jax.process_count()\n"
+        "    world = n\n"
+        "    if world > 1:\n"
+        "        multihost_utils.process_allgather(1)\n"
+    )
+    assert _clint("train/foo.py", chain) == []
+    # ...and demoting the chain ROOT demotes everything derived from it
+    poisoned = chain.replace(
+        "    world = n\n", "    world = n\n    n = object().x\n")
+    (f,) = _clint("train/foo.py", poisoned)
+    assert f.rule == "collective-divergent-branch"
+
+
+def test_collective_reassigned_uniform_name_is_demoted():
+    """Regression: a name once assigned from process_count() but LATER
+    rebound to a per-host value must not stay 'uniform' — the
+    flow-insensitive const-prop demotes any name with a non-uniform
+    binding anywhere in the function."""
+    reassigned = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "def f(self):\n"
+        "    n = jax.process_count()\n"
+        "    n = self._requested\n"
+        "    if n:\n"
+        "        multihost_utils.process_allgather(1)\n"
+    )
+    (f,) = _clint("train/foo.py", reassigned)
+    assert f.rule == "collective-divergent-branch"
+    # loop targets taint too — but only the TARGET name, not names
+    # uniformly assigned inside the loop body
+    looped = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "def f(batches):\n"
+        "    for b in batches:\n"
+        "        n = jax.process_count()\n"
+        "        if n > 1:\n"
+        "            multihost_utils.process_allgather(1)\n"
+        "        if b:\n"
+        "            return None\n"
+    )
+    found = _clint("train/foo.py", looped)
+    # the collective under the uniform `n > 1` is clean; nothing flags
+    # until the divergent `if b: return` — which sits AFTER it lexically
+    assert found == []
+
+
+def test_collective_bearing_helper_calls_flagged_and_waivable():
+    src = (
+        "def f(tr):\n"
+        "    if tr.health.bad:\n"
+        "        return True\n"
+        "    # p2p-lint: disable=collective-after-divergent-exit -- aligned by contract\n"
+        "    return tr.preempt.should_stop()\n"
+    )
+    (f,) = _clint("train/foo.py", src)
+    assert f.rule == "collective-after-divergent-exit" and f.waived
+
+
+def test_collectives_under_cond_jaxpr_rule():
+    from jax.experimental.shard_map import shard_map
+
+    from p2p_tpu.analysis.collective_consistency import (
+        collectives_under_cond,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    def f(pred, x):
+        inner = lambda v: jax.lax.psum(v, "data")       # noqa: E731
+        branch = lambda v: jax.lax.cond(                # noqa: E731
+            pred, inner, lambda w: w, v)
+        return shard_map(branch, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"), check_rep=False)(x)
+
+    jx = jax.make_jaxpr(f)(True, np.ones((2,), np.float32))
+    found = collectives_under_cond(jx, tag="fixture")
+    assert found and all(
+        f.rule == "jaxpr-collective-under-cond" for f in found)
+    # the where-select form (no cond) is clean
+    g = shard_map(lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+                  in_specs=P("data"), out_specs=P())
+    assert collectives_under_cond(
+        jax.make_jaxpr(g)(np.ones((2,), np.float32))) == []
+
+
+def test_collective_package_pass_is_clean_or_waived():
+    from p2p_tpu.analysis.collective_consistency import (
+        lint_package_collectives,
+    )
+
+    fs = lint_package_collectives()
+    assert fs, "the known waived agreement sites should be reported"
+    assert all(f.waived and f.waive_reason for f in fs), [
+        f.format() for f in fs if not f.waived]
+
+
+def test_chaos_elastic_spec_must_be_step_pinned():
+    """The real finding behind the poll_preempt waiver: a probabilistic
+    'elastic' seam fires on per-host RNG draws — one host preempts, the
+    rest hang in the next agreement collective. Rejected at parse."""
+    from p2p_tpu.resilience.chaos import parse_spec
+
+    assert "elastic" in parse_spec("elastic@3")
+    assert "elastic" in parse_spec("elastic@3x2,decode:0.5")
+    for bad in ("elastic:0.5", "elastic", "elastic:1.0x2"):
+        with pytest.raises(ValueError, match="step-pinned"):
+            parse_spec(bad)
+
+
+# --------------------------------------------------- memory audit (b)
+
+
+def test_donation_markers_single_device():
+    import re as _re
+
+    from p2p_tpu.analysis.memory_audit import (
+        donation_findings,
+        lowered_donation_markers,
+    )
+
+    x = {"a": np.ones((64, 64), np.float32), "b": np.ones((8,), np.float32)}
+    # clean: both donated leaves alias their outputs
+    low = jax.jit(lambda t: {"a": t["a"] + 1, "b": t["b"] * 2},
+                  donate_argnums=0).lower(x)
+    flags = lowered_donation_markers(low.as_text())
+    assert flags is not None and all(flags[:2])
+    assert donation_findings(low.as_text(), x, tag="clean") == []
+    # defeated: dtype changes, the donated buffer cannot be reused
+    low = jax.jit(lambda t: {"a": t["a"].astype(jnp.bfloat16),
+                             "b": t["b"] * 2},
+                  donate_argnums=0).lower(x)
+    found = donation_findings(low.as_text(), x, tag="defeated",
+                              min_bytes=1024)
+    assert len(found) == 1
+    assert found[0].rule == "memory-donation-defeated"
+    assert _re.search(r"\['a'\]", found[0].path)
+    # missing: no donation declared at all
+    low = jax.jit(lambda t: {"a": t["a"] + 1, "b": t["b"] * 2}).lower(x)
+    (f,) = donation_findings(low.as_text(), x, tag="missing")
+    assert f.rule == "memory-donation-missing"
+
+
+def test_donation_audit_aligns_through_pruned_unused_args():
+    """Regression: jit prunes UNUSED args from the lowered signature
+    (keep_unused=False), so a positional flag map would blame the wrong
+    leaf — the jaxpr's used-invar mask realigns it, and pruned leaves
+    are skipped (no buffer consumed, nothing to donate)."""
+    from p2p_tpu.analysis.memory_audit import donation_findings
+
+    tree = {"a": np.ones((64,), np.float32),
+            "unused": np.ones((512,), np.float32),
+            "z": np.ones((64,), np.float32)}
+    batch = np.ones((8,), np.float32)
+    jt = jax.jit(lambda t, b: ({"a": t["a"] + 1, "z": t["z"] * 2},
+                               b * 0.5), donate_argnums=0)
+    tr = jt.trace(tree, batch)
+    # with the jaxpr: 'z' maps to ITS OWN (aliased) parameter — clean
+    assert donation_findings(tr.lower().as_text(), tree, tag="t",
+                             min_bytes=1, jaxpr=tr.jaxpr) == []
+    # a genuinely defeated leaf still flags through the aligned map
+    jt2 = jax.jit(lambda t, b: ({"a": t["a"] + 1,
+                                 "z": t["z"].astype(jnp.bfloat16)},
+                                b * 0.5), donate_argnums=0)
+    tr2 = jt2.trace(tree, batch)
+    found = donation_findings(tr2.lower().as_text(), tree, tag="t",
+                              min_bytes=1, jaxpr=tr2.jaxpr)
+    assert len(found) == 1 and "['z']" in found[0].path
+
+
+def test_train_step_donation_is_clean():
+    """The live pin: the tiny-config GAN train step donates its WHOLE
+    TrainState — every sizeable leaf carries an aliasing/donor marker."""
+    import dataclasses as dc
+
+    from p2p_tpu.analysis.memory_audit import donation_findings
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = get_preset("facades")
+    cfg = dc.replace(
+        cfg,
+        model=dc.replace(cfg.model, ngf=8, ndf=8),
+        data=dc.replace(cfg.data, image_size=16, batch_size=2),
+    )
+    sample = {"input": np.zeros((2, 16, 16, 3), np.uint8),
+              "target": np.zeros((2, 16, 16, 3), np.uint8)}
+    ts = jax.eval_shape(lambda: create_train_state(
+        cfg, jax.random.key(0), sample, train_dtype=jnp.bfloat16))
+    sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), ts)
+    batch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in sample.items()}
+    low = build_train_step(cfg, train_dtype=jnp.bfloat16).lower(sds, batch)
+    assert donation_findings(low.as_text(), sds, tag="train_step") == []
+
+
+def test_traced_peak_bytes_liveness():
+    from p2p_tpu.analysis.memory_audit import traced_peak_bytes
+
+    def chain(x):
+        # sequential elementwise chain: peak = input + one temp
+        for _ in range(6):
+            x = x + 1.0
+        return x
+
+    n = 1024
+    jx = jax.make_jaxpr(chain)(np.ones((n,), np.float32))
+    peak = traced_peak_bytes(jx)
+    assert 2 * n * 4 <= peak <= 3 * n * 4, peak
+
+    def fanout(x):
+        # all six temps alive until the final sum: peak ~ 7 buffers
+        ys = [x * i for i in range(1, 7)]
+        out = ys[0]
+        for y in ys[1:]:
+            out = out + y
+        return out
+
+    jx2 = jax.make_jaxpr(fanout)(np.ones((n,), np.float32))
+    assert traced_peak_bytes(jx2) > peak
+
+
+def test_traced_peak_bytes_frees_dropvar_outputs():
+    """Regression: a discarded multi-output result (DropVar) must count
+    toward its own eqn's peak only — never accumulate in the live set
+    (it has no uses, so last-use bookkeeping would pin it forever)."""
+    from p2p_tpu.analysis.memory_audit import traced_peak_bytes
+
+    n = 1024
+
+    def chain_with_drops(x):
+        for _ in range(8):
+            # div_p returns one output; use divmod-style double results
+            q, _r = jnp.divmod(x, 3.0)   # _r dropped every iteration
+            x = q + 1.0
+        return x
+
+    jx = jax.make_jaxpr(chain_with_drops)(np.ones((n,), np.float32))
+    # any DropVars present must not stack: peak stays a few buffers, not
+    # O(iterations) buffers
+    assert traced_peak_bytes(jx) <= 5 * n * 4
+
+
+def test_memory_budget_table_structure():
+    from p2p_tpu.analysis.memory_audit import memory_budget_table
+
+    rows, findings = memory_budget_table(
+        matrix=(("facades", ({"data": 1}, {"data": 1, "model": 2})),))
+    assert len(rows) == 2
+    r0, r1 = rows
+    assert r0["canonical"] and not r1["canonical"]
+    b = r0["bytes"]
+    assert b["params"] > 0 and b["opt"] > b["params"]   # 2 Adam moments
+    assert b["activation_peak"] > 0
+    assert b["total"] == b["state_total"] + b["activation_peak"]
+    # the model axis shards the TP pairs: state shrinks, activations don't
+    assert r1["bytes"]["state_total"] < r0["bytes"]["state_total"]
+    assert r1["bytes"]["activation_peak"] == r0["bytes"]["activation_peak"]
+    # every row reports at info level (the canonical row only escalates
+    # to warning when over budget — these fit)
+    assert all(f.severity == INFO for f in findings)
+
+
+def test_serving_template_prunes_params_when_ema(tmp_path):
+    """The real memory finding fixed in this PR: the EMA-serving restore
+    template must NOT read params_g just to discard it — the pruned
+    template restores half the generator bytes."""
+    import dataclasses as dc
+
+    from p2p_tpu.analysis.memory_audit import (
+        dead_restore_findings,
+        template_dead_restore_findings,
+    )
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.serve.engine import serving_restore_template
+    from p2p_tpu.train.state import create_infer_state, tree_bytes
+
+    cfg = get_preset("facades")
+    cfg = dc.replace(
+        cfg,
+        model=dc.replace(cfg.model, ngf=8, ndf=8),
+        data=dc.replace(cfg.data, image_size=16, batch_size=1),
+        health=dc.replace(cfg.health, ema_decay=0.999),
+    )
+    sample = {"input": np.zeros((1, 16, 16, 3), np.uint8),
+              "target": np.zeros((1, 16, 16, 3), np.uint8)}
+    pruned = jax.eval_shape(
+        lambda: serving_restore_template(cfg, sample))
+    assert not jax.tree_util.tree_leaves(pruned.params_g)
+    assert jax.tree_util.tree_leaves(pruned.ema_g)
+    # the unpruned template (the OLD behavior) restores ~2x the bytes
+    # and is exactly what the dead-restore rule flags
+    full = jax.eval_shape(
+        lambda: create_infer_state(cfg, jax.random.key(0), sample))
+    assert tree_bytes(pruned) < tree_bytes(full)
+    (f,) = template_dead_restore_findings(full, tag="old-behavior")
+    assert f.rule == "memory-dead-restore" and f.severity == ERROR
+    # the LIVE helper is clean — the standing gate
+    assert dead_restore_findings() == []
+
+
+# ------------------------------------------------ concurrency lint (c)
+
+
+def _conc(relpath, src):
+    from p2p_tpu.analysis.concurrency_lint import lint_concurrency_source
+
+    return lint_concurrency_source(relpath, src)
+
+
+def test_conc_signal_handler_lock_fixture():
+    src = (
+        "import signal\n"
+        "class G:\n"
+        "    def install(self):\n"
+        "        signal.signal(signal.SIGTERM, self._handler)\n"
+        "    def _handler(self, signum, frame):\n"
+        "        with self._lock:\n"
+        "            self.flag = True\n"
+        "        self.registry.flush()\n"
+    )
+    found = _conc("resilience/foo.py", src)
+    rules = [f.rule for f in found]
+    assert rules == ["conc-signal-handler-unsafe"] * 2
+    assert "self._lock" in found[0].message      # the with-lock block
+    assert "flush" in found[1].message           # the buffered-IO call
+    # the deferral pattern (thread hand-off) is clean
+    clean = (
+        "import signal, threading\n"
+        "class G:\n"
+        "    def install(self):\n"
+        "        signal.signal(signal.SIGTERM, self._handler)\n"
+        "    def _handler(self, signum, frame):\n"
+        "        self.flag = True\n"
+        "        threading.Thread(target=self._side).start()\n"
+    )
+    assert _conc("resilience/foo.py", clean) == []
+
+
+def test_conc_signal_handler_resolution_is_class_scoped():
+    """Regression: only the class whose method is actually installed via
+    signal.signal gets its handler audited — a same-named method on
+    another class may flush freely."""
+    src = (
+        "import signal\n"
+        "class A:\n"
+        "    def install(self):\n"
+        "        signal.signal(signal.SIGTERM, self._handler)\n"
+        "    def _handler(self, s, f):\n"
+        "        self.flag = True\n"
+        "class B:\n"
+        "    def _handler(self, s, f):\n"    # never registered
+        "        self.registry.flush()\n"
+    )
+    assert _conc("resilience/foo.py", src) == []
+    bad = src.replace("self.flag = True", "self.registry.flush()")
+    found = _conc("resilience/foo.py", bad)
+    assert [f.line for f in found] == [6], [f.format() for f in found]
+
+
+def test_conc_unlocked_shared_mutation_fixture():
+    src = (
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._sinks = []\n"
+        "    def good(self, s):\n"
+        "        with self._lock:\n"
+        "            self._sinks.append(s)\n"
+        "    def bad(self, s):\n"
+        "        self._sinks.append(s)\n"
+        "    def count(self):\n"
+        "        self._n += 1\n"
+    )
+    found = _conc("obs/foo.py", src)
+    assert [f.rule for f in found] == ["conc-unlocked-shared-mutation"] * 2
+    assert found[0].severity == ERROR and found[0].line == 10
+    assert found[1].severity == WARNING          # the += read-modify-write
+    # a class with no lock is out of scope (nothing claims thread-safety)
+    nolock = ("class P:\n"
+              "    def __init__(self):\n"
+              "        self._sinks = []\n"
+              "    def add(self, s):\n"
+              "        self._sinks.append(s)\n")
+    assert _conc("obs/foo.py", nolock) == []
+
+
+def test_conc_mutator_calls_found_in_any_expression():
+    """Regression: pop-and-use shapes (`x = q.pop()`, `if q.pop():`,
+    `return q.pop()`) are mutations too — not just bare `q.append(...)`
+    statements."""
+    src = (
+        "import threading\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = []\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.pop()\n"
+        "    def bad_assign(self):\n"
+        "        x = self._q.pop(0)\n"
+        "        return x\n"
+        "    def bad_cond(self):\n"
+        "        if self._q.pop():\n"
+        "            return 1\n"
+        "    def bad_return(self):\n"
+        "        return self._q.pop()\n"
+    )
+    found = _conc("obs/foo.py", src)
+    assert [f.line for f in found] == [10, 13, 16], [
+        f.format() for f in found]
+    assert all(f.rule == "conc-unlocked-shared-mutation" for f in found)
+
+
+def test_conc_atexit_join_fixture():
+    src = (
+        "import atexit\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        atexit.register(self.close)\n"
+        "    def close(self):\n"
+        "        self._pool.shutdown(wait=True)\n"
+    )
+    (f,) = _conc("serve/foo.py", src)
+    assert f.rule == "conc-atexit-thread-join" and f.severity == WARNING
+    # a flush-only close is fine
+    clean = src.replace("self._pool.shutdown(wait=True)", "self.flush()")
+    assert [f.rule for f in _conc("serve/foo.py", clean)] == []
+
+
+def test_conc_atexit_handler_resolution_is_class_scoped():
+    """Regression: ``atexit.register(self.close)`` must resolve to the
+    ENCLOSING class's close — two classes sharing a method name in one
+    module must not audit the first definition for both registrations."""
+    src = (
+        "import atexit\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        atexit.register(self.close)\n"
+        "    def close(self):\n"
+        "        self.flush()\n"            # clean close
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        atexit.register(self.close)\n"
+        "    def close(self):\n"
+        "        self._pool.shutdown(wait=True)\n"   # the joining one
+    )
+    (f,) = _conc("serve/foo.py", src)
+    assert f.rule == "conc-atexit-thread-join" and f.line == 11
+
+
+def test_concurrency_package_pass_is_clean_or_waived():
+    from p2p_tpu.analysis.concurrency_lint import lint_package_concurrency
+
+    fs = lint_package_concurrency()
+    assert fs, "the documented single-thread contracts should be reported"
+    assert all(f.waived and f.waive_reason for f in fs), [
+        f.format() for f in fs if not f.waived]
+
+
+# ------------------------------------- host-callback partial resolution
+
+
+def test_host_callback_resolves_partial_and_allows_by_target():
+    import functools
+
+    from p2p_tpu.analysis.jaxpr_lint import host_callback_findings
+
+    def _obs_tap(counts, *, tag):
+        del counts, tag
+
+    def step(x):
+        jax.debug.callback(functools.partial(_obs_tap, tag="t"), x)
+        return x * 2
+
+    jx = jax.make_jaxpr(step)(1.0)
+    (f,) = host_callback_findings(jx, tag="hot")
+    # the finding names the RESOLVED user function, not jax's wrapper
+    assert "_obs_tap" in f.message
+    # allow by target function name: THIS callback passes...
+    assert host_callback_findings(jx, tag="hot", allow=["_obs_tap"]) == []
+
+    def step2(x):
+        jax.debug.callback(functools.partial(_obs_tap, tag="t"), x)
+        jax.debug.callback(lambda v: None, x)
+        return x * 2
+
+    # ...while any OTHER callback in the same program still flags
+    found = host_callback_findings(jax.make_jaxpr(step2)(1.0),
+                                   tag="hot", allow=["_obs_tap"])
+    assert len(found) == 1 and "<lambda>" in found[0].message
+
+
+def test_nan_sentinel_program_passes_with_target_allow():
+    """The traced-coverage satellite's pin: the sentinel-enabled train
+    step's ONE debug_callback resolves to obs/taps._on_counts through
+    jax's flat-callback closure + one functools.partial level."""
+    import dataclasses as dc
+
+    from p2p_tpu.analysis.jaxpr_lint import host_callback_findings
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = get_preset("facades")
+    cfg = dc.replace(
+        cfg,
+        model=dc.replace(cfg.model, ngf=8, ndf=8),
+        data=dc.replace(cfg.data, image_size=16, batch_size=2),
+        debug=dc.replace(cfg.debug, nan_sentinel=True),
+    )
+    sample = {"input": np.zeros((2, 16, 16, 3), np.uint8),
+              "target": np.zeros((2, 16, 16, 3), np.uint8)}
+    ts = jax.eval_shape(lambda: create_train_state(
+        cfg, jax.random.key(0), sample, train_dtype=jnp.bfloat16))
+    sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), ts)
+    batch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in sample.items()}
+    jx = jax.make_jaxpr(build_train_step(
+        cfg, train_dtype=jnp.bfloat16, jit=False))(sds, batch)
+    # unallowed: the sentinel callback IS found (and named)
+    found = host_callback_findings(jx, tag="train_step+sentinel")
+    assert found and any("_on_counts" in f.message for f in found)
+    # allowed by resolved target: clean — the lint CLI's standing config
+    assert host_callback_findings(jx, tag="train_step+sentinel",
+                                  allow=["_on_counts"]) == []
