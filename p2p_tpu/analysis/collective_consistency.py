@@ -72,6 +72,15 @@ COLLECTIVE_BEARING = frozenset({
     "poll_preempt",                  # train loops' step-boundary poll
     "combine_process_metric_stats",  # eval stats allgather
     "aggregate",                     # MetricsRegistry cross-host reduce
+    # elastic restore path (resilience/reshape.py): the plan decides —
+    # and elastic_restore executes — a cross-host Orbax load plus the
+    # `migrate` verdict's restore-time transform chain (batch_rebase /
+    # pp_restructure / tp_amax_recalibrate / dtype_cast, see
+    # reshape.RESHAPE_TRANSFORMS); a host that skips either call (or
+    # reaches it with a different plan) strands every other host's
+    # restore collectives
+    "plan_elastic_restore",
+    "elastic_restore",
 })
 
 #: calls whose value is identical on every host

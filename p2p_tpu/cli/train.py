@@ -105,6 +105,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "hands back the slice size it reclaimed. On by "
                         "default; --no-elastic restores the strict "
                         "contract (any topology delta aborts)")
+    p.add_argument("--cast_on_restore", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="opt-in dtype-policy migration on resume: a "
+                        "mixed-precision/--moment_dtype change performs "
+                        "an explicit, logged cast (moments follow the "
+                        "migration policy table; the integrity manifest "
+                        "is regenerated post-cast) instead of exiting 2 "
+                        "(resilience/reshape.py)")
+    p.add_argument("--recalibrate_steps", type=int, default=None,
+                   help="after a TP-width int8-amax migration, hold the "
+                        "remapped scales frozen for this many dispatches "
+                        "before the decaying-max update resumes "
+                        "(default 0 = trust the closed-form remap)")
     # --- self-healing knobs (p2p_tpu.resilience.health) -------------------
     p.add_argument("--health", action=argparse.BooleanOptionalAction,
                    default=None,
@@ -274,7 +287,9 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  pool_size=args.pool_size, save_masks=args.save_masks,
                  log_every=args.log_every,
                  compilation_cache_dir=args.compilation_cache,
-                 elastic=args.elastic)
+                 elastic=args.elastic,
+                 cast_on_restore=args.cast_on_restore,
+                 recalibrate_steps=args.recalibrate_steps)
     debug = over(cfg.debug, check_finite=args.check_finite,
                  nan_sentinel=args.nan_sentinel, grad_norms=args.grad_norms)
     health = over(cfg.health, enabled=args.health,

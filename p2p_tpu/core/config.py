@@ -271,6 +271,16 @@ class TrainConfig:
     # a different slice size. False = the strict pre-elastic contract:
     # any topology delta aborts with a diagnostic instead of resharding.
     elastic: bool = True
+    # Opt-in dtype-policy migration on resume (resilience/reshape.py):
+    # a mixed_precision/moment_dtype delta performs an explicit, LOGGED
+    # cast (moments per the MOMENT_MIGRATION policy table, integrity
+    # manifest regenerated post-cast) instead of aborting. False = the
+    # safe default: dtype deltas abort with the flag named.
+    cast_on_restore: bool = False
+    # After a TP-width amax migration (tp_amax_recalibrate), hold the
+    # remapped int8 scales FROZEN for this many dispatches — the paranoid
+    # path's warmup before the decaying-max update resumes. 0 = off.
+    recalibrate_steps: int = 0
     # jax_debug_nans: first NaN-producing primitive raises with location.
     debug_nans: bool = False
     # The reference's commented "masking" experiment (train.py:324-334):

@@ -155,31 +155,52 @@ class TopologyDelta:
       axis widths, device count): restore proceeds with target shardings
       derived for the NEW mesh, and the per-host data skip re-derives
       from the global step
-    - ``"abort"``   an incompatible delta (global batch, dtype policy,
-      pipe width, TP width under int8 amax state): resuming would corrupt
-      sample accounting or state semantics — fail with instructions
+    - ``"migrate"`` a delta that is lawful only THROUGH a restore-time
+      state transform (p2p_tpu.resilience.reshape): ``chain`` names the
+      transforms, in application order — ``batch_rebase`` (global-batch
+      change: step/epoch/LR basis re-derived from cumulative samples),
+      ``pp_restructure`` (pipe-width change: trunk merge + re-split),
+      ``tp_amax_recalibrate`` (TP-width change under delayed-int8 amax
+      state: closed-form max/broadcast scale remap), ``dtype_cast``
+      (explicit, logged dtype-policy cast — opt-in via
+      ``--cast_on_restore``)
+    - ``"abort"``   a genuinely unreconcilable delta (dtype policy
+      without the cast opt-in, ``int8_delayed`` on/off — the TrainState
+      TREE differs, no cast fixes that): fail with instructions
     """
 
     kind: str
     reason: str
+    #: migrate-only: transform names, in the order reshape.py applies them
+    chain: tuple = ()
 
 
 def classify_topology_delta(saved: dict, current: dict,
-                            has_quant_state: bool = False) -> TopologyDelta:
+                            has_quant_state: bool = False,
+                            cast_on_restore: bool = False) -> TopologyDelta:
     """Reconcile a checkpoint's recorded topology block against the
     relaunch's. Rules (the narrow, auditable core of elastic resume):
 
-    - ``global_batch`` change → abort: ``steps_per_epoch`` and the
-      optimizer trajectory both shift, so gapless sample accounting is
-      impossible — the step counter no longer names a sample position.
-    - dtype-policy change (``mixed_precision``/``moment_dtype``/
-      ``int8_delayed``) → abort: Orbax would silently cast, changing
-      numerics without a trace.
-    - ``pipe`` width change → abort: pp_split_state restructures the
-      TrainState tree itself, not just shardings.
+    - ``global_batch`` change → migrate (``batch_rebase``): the step
+      counter stops naming a sample position, so step/epoch position,
+      ``steps_per_epoch``, the LR-schedule basis, and the loader's skip
+      arithmetic are re-derived from the sidecar's cumulative
+      ``samples_seen`` — accounting stays gapless in SAMPLES.
+    - ``mixed_precision``/``moment_dtype`` change → migrate
+      (``dtype_cast``) when ``cast_on_restore`` (the ``--cast_on_restore``
+      opt-in): the cast is explicit and logged, optimizer moments follow
+      the migration policy table, and the integrity manifest is
+      regenerated post-cast; WITHOUT the opt-in → abort (Orbax would
+      silently cast, changing numerics without a trace).
+    - ``int8_delayed`` change → abort always: the TrainState TREE
+      differs (quant collections appear/disappear) — not a cast.
+    - ``pipe`` width change → migrate (``pp_restructure``): the
+      stage-stacked trunk merges back to the flat trunk and re-splits at
+      the new width (pipe→no-pipe and no-pipe→pipe are the degenerate
+      cases), optimizer moments preserved.
     - ``model`` (TP) width change under delayed-int8 quant state →
-      abort: the stored per-layer amax scales were calibrated against
-      the saved shard width.
+      migrate (``tp_amax_recalibrate``): amax is a max statistic, so the
+      resharding law is closed-form (ops/int8.reshard_amax).
     - any other mesh-axis / process-count / device-count change →
       reshard (params are replicated or rule-resharded over these axes;
       the input pipeline re-derives per-host shards from the global
@@ -189,26 +210,48 @@ def classify_topology_delta(saved: dict, current: dict,
     forward-compatible by construction.
     """
     def differs(key):
-        return key in saved and saved[key] != current.get(key)
+        if key not in saved:
+            return False
+        a, b = saved[key], current.get(key)
+        if key == "moment_dtype":
+            # None IS float32 (the optimizer default, train/state.py):
+            # an explicit --moment_dtype float32 against an unset save
+            # (or vice versa) is a spelling difference, not a cast
+            a, b = a or "float32", b or "float32"
+        return a != b
 
-    for key, why in (
-        ("global_batch",
-         "the global batch size changed — steps_per_epoch and sample "
-         "accounting cannot line up; relaunch with the original "
-         "--batch_size"),
-        ("mixed_precision",
-         "the mixed-precision policy changed — restore would silently "
-         "cast the state; relaunch with the original precision flags"),
-        ("moment_dtype",
-         "the Adam moment storage dtype changed — restore would silently "
-         "cast the optimizer state; relaunch with the original "
-         "--moment_dtype"),
-        ("int8_delayed",
-         "the delayed-int8 policy changed — the TrainState tree differs "
-         "(quant collections); relaunch with the original --int8_delayed"),
-    ):
+    chain = []
+    reasons = []
+    if differs("global_batch"):
+        chain.append("batch_rebase")
+        reasons.append(
+            f"the global batch size changed "
+            f"({saved.get('global_batch')} -> "
+            f"{current.get('global_batch')}) — step/epoch position and "
+            "the LR-schedule basis re-derive from cumulative samples")
+    for key, what in (("mixed_precision", "the mixed-precision policy"),
+                      ("moment_dtype", "the Adam moment storage dtype")):
         if differs(key):
-            return TopologyDelta("abort", why)
+            if not cast_on_restore:
+                return TopologyDelta(
+                    "abort",
+                    f"{what} changed ({saved.get(key)} -> "
+                    f"{current.get(key)}) — restore would silently cast "
+                    "the state; relaunch with the original dtype flags, "
+                    "or opt in to an explicit, logged cast with "
+                    "--cast_on_restore")
+            if "dtype_cast" not in chain:
+                chain.append("dtype_cast")
+            reasons.append(
+                f"{what} changed ({saved.get(key)} -> "
+                f"{current.get(key)}) — cast on restore "
+                "(--cast_on_restore)")
+    if differs("int8_delayed"):
+        return TopologyDelta(
+            "abort",
+            "the delayed-int8 policy changed — the TrainState tree "
+            "differs (quant collections), which no cast reconciles; "
+            "relaunch with the original --int8_delayed")
     # A sidecar with no "mesh" key at all (pre-elastic) recorded nothing
     # to reconcile mesh-wise — skip the axis comparisons. An EMPTY
     # recorded mesh (a single-device save) is different: relaunching onto
@@ -222,24 +265,30 @@ def classify_topology_delta(saved: dict, current: dict,
 
     if has_saved_mesh:
         if axis(saved_mesh, PIPE_AXIS) != axis(cur_mesh, PIPE_AXIS):
-            return TopologyDelta(
-                "abort",
-                "the pipeline-parallel width changed — pp_split_state "
-                "restructures the TrainState tree; relaunch with the "
-                "original pipe axis")
+            chain.append("pp_restructure")
+            reasons.append(
+                f"the pipeline-parallel width changed "
+                f"({axis(saved_mesh, PIPE_AXIS)} -> "
+                f"{axis(cur_mesh, PIPE_AXIS)}) — the stacked trunk "
+                "merges and re-splits at the new width")
         if axis(saved_mesh, MODEL_AXIS) != axis(cur_mesh, MODEL_AXIS) \
                 and has_quant_state:
-            return TopologyDelta(
-                "abort",
-                "the tensor-parallel width changed under delayed-int8 amax "
-                "state — stored activation scales are calibrated per shard "
-                "width; relaunch with the original model axis (or resume "
-                "without --int8_delayed from a fresh run)")
+            chain.append("tp_amax_recalibrate")
+            reasons.append(
+                f"the tensor-parallel width changed "
+                f"({axis(saved_mesh, MODEL_AXIS)} -> "
+                f"{axis(cur_mesh, MODEL_AXIS)}) under delayed-int8 amax "
+                "state — stored scales remap by the closed-form max law")
     changed = [k for k in ("process_count", "device_count")
                if differs(k)]
     if has_saved_mesh:
         changed += [f"mesh.{a}" for a in set(saved_mesh) | set(cur_mesh)
                     if axis(saved_mesh, a) != axis(cur_mesh, a)]
+    if chain:
+        if changed:
+            reasons.append("topology delta: " + ", ".join(sorted(changed)))
+        return TopologyDelta("migrate", "; ".join(reasons),
+                             chain=tuple(chain))
     if changed:
         return TopologyDelta(
             "reshard", "topology delta: " + ", ".join(sorted(changed)))

@@ -242,6 +242,7 @@ def shard_epoch_indices(
     n_proc: Optional[int] = None,
     pid: Optional[int] = None,
     drop_remainder: bool = True,
+    skip_samples: int = 0,
 ) -> list:
     """THE per-host index arithmetic of the fallback loader: one epoch's
     (already shuffled) global index vector → this host's batch-aligned,
@@ -273,20 +274,55 @@ def shard_epoch_indices(
     ``n_proc`` (shard length is ``q*local_bs + floor-of-(r/n_proc)`` and
     ``r/n_proc < local_bs``), so steps-per-epoch is the topology-invariant
     ``floor(n/B)``.
+
+    ``skip_samples`` is the SAMPLE-granular form of the skip: drop the
+    flat permutation prefix ``[0, S)`` — host ``p`` drops its shard rows
+    with flat position ``s·n_proc + p < S``, i.e. ``ceil((S − p)/n_proc)``
+    rows. This is the elastic BATCH-CHANGE resume law
+    (resilience/reshape.py ``batch_rebase``): the dead run consumed a
+    prefix that is a multiple of the OLD global batch, which the NEW
+    batch need not divide — sample granularity keeps the union of the
+    relaunch's batch ``i`` at exactly flat ``[S + i·B_new, S + (i+1)·B_new)``
+    (any length-``B`` flat window holds exactly ``local_bs`` members of
+    every congruence class — even when ``S`` is unaligned), so old-batch
+    prefix ∪ new-batch suffix tiles the permutation gaplessly. Under
+    ``drop_remainder`` every host is additionally truncated to
+    ``usable//B − ceil(S/B)`` batches: hosts whose post-skip row counts
+    differ by one (unaligned ``S``) agree on the epoch's step count, and
+    the count matches the ceil-charged step re-base
+    (reshape.apply_batch_rebase charges ``ceil(S/B)`` steps for the
+    prefix, so prefix-steps + suffix-batches == the topology-invariant
+    ``steps_per_epoch`` exactly — a plain ``(usable−S)//B`` floor would
+    overshoot by one whenever the unconsumed part of the prefix's last
+    window fits in the epoch tail, desynchronizing ``step %
+    steps_per_epoch`` forever after). ``skip_batches`` (``= S/B`` when
+    aligned) is the legacy form; the two are mutually exclusive.
     """
     idx = np.asarray(idx)
     if n_proc is None:
         n_proc = jax.process_count()
     if pid is None:
         pid = jax.process_index()
+    if skip_batches and skip_samples:
+        raise ValueError("pass skip_batches OR skip_samples, not both")
+    n_usable = len(idx)
     if n_proc > 1:
         if drop_remainder:
             # equal-sized shards (Grain's drop_remainder semantics): an
             # uneven split would hand one process an extra batch whose
             # collectives the others never join — deadlock
             idx = idx[: len(idx) - len(idx) % n_proc]
+            n_usable = len(idx)
         idx = idx[pid::n_proc]
-    if skip_batches > 0:
+    if skip_samples > 0:
+        s = int(skip_samples)
+        drop = (s - pid + n_proc - 1) // n_proc if s > pid else 0
+        idx = idx[drop:]
+        if drop_remainder:
+            b = batch_size * n_proc
+            n_b = max(0, n_usable // b - -(-s // b))
+            idx = idx[: n_b * batch_size]
+    elif skip_batches > 0:
         # resume mid-epoch: local batch i is shard rows [i·bs, (i+1)·bs),
         # so dropping skip·bs leading indices leaves every later batch's
         # membership and order IDENTICAL to an uninterrupted epoch — zero
@@ -305,6 +341,7 @@ def make_loader(
     drop_remainder: bool = True,
     skip_batches: int = 0,
     registry=None,
+    skip_samples: int = 0,
 ):
     """Host-batch iterator with per-JAX-process sharding.
 
@@ -319,6 +356,13 @@ def make_loader(
     its epoch from batch N without replaying batches 0..N-1. The fallback
     skips by index arithmetic (no decode cost); Grain consumes and
     discards N batches once (decode cost paid, order preserved).
+
+    ``skip_samples`` is the sample-granular form (global flat-permutation
+    prefix — see :func:`shard_epoch_indices`): the elastic batch-change
+    resume uses it because the consumed prefix is a multiple of the OLD
+    global batch only. On the Grain path it must be batch-aligned (mid-
+    epoch topology changes under Grain are refused upstream by
+    ``plan_elastic_restore``; a same-run resume is always aligned).
     """
     try:
         if os.environ.get("P2P_TPU_NO_GRAIN") == "1":
@@ -332,6 +376,7 @@ def make_loader(
             rng = np.random.default_rng(seed)
             epoch = 0
             skip = max(0, int(skip_batches))
+            skip_s = max(0, int(skip_samples))
             while num_epochs is None or epoch < num_epochs:
                 idx = np.arange(len(dataset))
                 if shuffle:
@@ -341,8 +386,9 @@ def make_loader(
                 # elastic shard-accounting tests
                 local = shard_epoch_indices(
                     idx, batch_size, skip_batches=skip,
-                    drop_remainder=drop_remainder)
+                    drop_remainder=drop_remainder, skip_samples=skip_s)
                 skip = 0
+                skip_s = 0
                 yield from _Stacked(dataset, batch_size, local,
                                     drop_remainder)
                 epoch += 1
@@ -363,10 +409,23 @@ def make_loader(
         worker_count=num_workers,
     )
     it = iter(loader)
-    if skip_batches > 0:
+    skip = max(0, int(skip_batches))
+    if skip_samples > 0:
+        # Grain consumes whole local batches; a sample-granular prefix
+        # only arises on a batch-change migration, which the elastic
+        # reconciliation already refuses under Grain mid-epoch.
+        global_b = batch_size * jax.process_count()
+        if skip_samples % global_b:
+            raise ValueError(
+                f"skip_samples={skip_samples} is not a whole number of "
+                f"global batches ({global_b}) — the Grain loader cannot "
+                "skip a partial batch; run with P2P_TPU_NO_GRAIN=1 for "
+                "sample-granular elastic accounting")
+        skip += skip_samples // global_b
+    if skip > 0:
         def skipping():
             for i, b in enumerate(it):
-                if i >= skip_batches:
+                if i >= skip:
                     yield b
 
         return skipping()

@@ -318,6 +318,51 @@ int8_conv_ds.defvjp(_int8_conv_ds_fwd, _int8_conv_ds_bwd)
 AMAX_DECAY = 0.95
 
 
+def reshard_amax(amax: jax.Array, old_width: int,
+                 new_width: int) -> jax.Array:
+    """Closed-form amax resharding law for a TP-width change under
+    delayed-int8 state (the elastic ``tp_amax_recalibrate`` migration,
+    p2p_tpu.resilience.reshape).
+
+    amax is a MAX statistic, so the law needs no data pass:
+
+    - a **per-tensor** amax (scalar, or any leaf without a leading
+      ``old_width`` shard axis — the repo's ``amax_x`` scalars, whose
+      ``jnp.max`` is a GLOBAL reduction under GSPMD) is shard-width
+      invariant: every shard of the activation quantizes with the same
+      global scale — identity;
+    - a **per-shard** amax (leading ``[old_width]`` axis) remaps so each
+      new shard takes the max over the old shards overlapping its channel
+      range: on WIDEN (more, smaller shards) each old shard broadcasts to
+      its children (the containing shard's amax is a safe, exact-or-upper
+      bound for every sub-range); on NARROW (fewer, bigger shards) each
+      new shard maxes over the old shards it absorbs (exact: max of
+      maxes). Widen-then-narrow round-trips bitwise
+      (``max(a, a) == a`` — pinned in tests/test_int8.py).
+
+    Widths must divide (the mesh resolve already enforces power-of-two
+    style factorings); anything else raises with the two widths named.
+    """
+    amax = jnp.asarray(amax)
+    old_width, new_width = int(old_width), int(new_width)
+    if old_width == new_width:
+        return amax
+    if amax.ndim == 0 or amax.shape[0] != old_width:
+        return amax  # per-tensor scale: shard-width invariant
+    if new_width > old_width:
+        if new_width % old_width:
+            raise ValueError(
+                f"cannot widen amax shards {old_width} -> {new_width}: "
+                "widths must divide")
+        return jnp.repeat(amax, new_width // old_width, axis=0)
+    if old_width % new_width:
+        raise ValueError(
+            f"cannot narrow amax shards {old_width} -> {new_width}: "
+            "widths must divide")
+    k = old_width // new_width
+    return jnp.max(amax.reshape((new_width, k) + amax.shape[1:]), axis=1)
+
+
 def amax_update(cur_amax: jax.Array, stored: jax.Array) -> jax.Array:
     """The delayed-scale update law: max(cur, AMAX_DECAY·stored).
 

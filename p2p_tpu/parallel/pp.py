@@ -75,13 +75,10 @@ def stack_trunk(variables: Dict[str, Any], n_stages: int,
     if n_blocks % n_stages:
         raise ValueError(
             f"{n_blocks} trunk blocks not divisible by {n_stages} stages")
-    per = n_blocks // n_stages
-
     def gather(collection):
-        blocks = [collection[n] for n in names]
-        flat = jax.tree.map(lambda *leaves: jnp.stack(leaves), *blocks)
-        return jax.tree.map(
-            lambda a: a.reshape((n_stages, per) + a.shape[1:]), flat)
+        # ONE stacking law (shared with the init_opt=False opt-moment
+        # split): the params-derived block list drives every collection
+        return _gather_stack(collection, prefix, n_stages, names=names)
 
     stacked = {"params": gather(variables["params"])}
     # stage-regular non-param collections ride along: BN running stats and
@@ -424,28 +421,159 @@ def pp_expand_forward(model_cfg, variables: Dict[str, Any], x_mb: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-def pp_split_state(state, cfg, mesh: Mesh, steps_per_epoch: int = 1):
-    """Move the generator trunk out of a fresh TrainState into the
+def _trunk_dict_map(tree, prefix: str, fn):
+    """Apply ``fn`` to every dict node of ``tree`` that holds trunk-block
+    entries (keys starting with ``prefix``), leaving everything else —
+    including the optax wrapper scalars (counts, hyperparams) — intact.
+    The Adam mu/nu trees mirror the param tree, so ONE traversal rule
+    restructures params, batch_stats, quant, and both moments."""
+    def is_trunk_dict(x):
+        return isinstance(x, dict) and any(
+            isinstance(k, str) and k.startswith(prefix) for k in x)
+
+    return jax.tree_util.tree_map(
+        lambda n: fn(n) if is_trunk_dict(n) else n,
+        tree, is_leaf=is_trunk_dict)
+
+
+def _trunk_names(tree: Dict[str, Any], prefix: str):
+    names = [n for n in tree if n.startswith(prefix)]
+    names.sort(key=lambda n: int(n[len(prefix):]))
+    return names
+
+
+def _gather_stack(tree: Dict[str, Any], prefix: str, n_stages: int,
+                  names=None):
+    """{block_i: subtree} → one-block-shaped subtree with [S, B] leaves —
+    THE stacking law: block ``s*B + j`` lands at ``[s, j]``. Used by
+    :func:`stack_trunk` (which passes the params-derived ``names`` so a
+    collection missing a block fails loudly) and by the init_opt=False
+    moment split on any param-mirroring dict."""
+    if names is None:
+        names = _trunk_names(tree, prefix)
+    per = len(names) // n_stages
+    blocks = [tree[n] for n in names]
+    flat = jax.tree.map(lambda *leaves: jnp.stack(leaves), *blocks)
+    return jax.tree.map(
+        lambda a: a.reshape((n_stages, per) + a.shape[1:]), flat)
+
+
+def unstack_trunk(stacked: Dict[str, Any], prefix: str) -> Dict[str, Any]:
+    """Inverse of the ``stack_trunk`` gather on ONE collection subtree:
+    a one-block-shaped tree with [S, B] leading axes → ``{prefix}{i}``
+    per-block subtrees, block ``s*B + j`` read from ``[s, j]`` (the same
+    ordering law, so merge-then-split round-trips bitwise)."""
+    leaves = jax.tree_util.tree_leaves(stacked)
+    if not leaves:
+        return {}
+    s, b = leaves[0].shape[:2]
+    flat = jax.tree.map(lambda a: a.reshape((s * b,) + a.shape[2:]), stacked)
+    return {f"{prefix}{i}": jax.tree.map(lambda a: a[i], flat)
+            for i in range(s * b)}
+
+
+def pp_merge_state(state, cfg, steps_per_epoch: int = 1):
+    """Inverse of :func:`pp_split_state`: fold the stage-stacked trunk
+    (``pp_stages`` + ``opt_s``) back into the flat generator tree.
+
+    The per-block params / batch_stats / quant entries re-enter
+    ``params_g``/``batch_stats_g``/``quant_g`` under their original
+    ``{prefix}{i}`` names, and ``opt_g`` is rebuilt over the full tree
+    with the trunk leaves' Adam moments UNSTACKED from ``opt_s`` (per-leaf
+    Adam is independent per leaf, so the merged trajectory is the split
+    one — nothing is re-initialized). The elastic pipe-width migration
+    (p2p_tpu.resilience.reshape) uses merge → :func:`pp_split_state`
+    (``init_opt=False``) to re-express a checkpoint at any new width,
+    pipe→no-pipe and no-pipe→pipe included.
+    """
+    from p2p_tpu.train.state import make_optimizers
+
+    if state.pp_stages is None:
+        return state
+    prefix = trunk_prefix(cfg.model)
+    stacked = state.pp_stages
+    params_g = {**state.params_g, **unstack_trunk(stacked["params"], prefix)}
+    batch_stats_g = state.batch_stats_g
+    if "batch_stats" in stacked:
+        batch_stats_g = {**(batch_stats_g or {}),
+                         **unstack_trunk(stacked["batch_stats"], prefix)}
+    quant_g = state.quant_g
+    if "quant" in stacked:
+        quant_g = {**(quant_g or {}),
+                   **unstack_trunk(stacked["quant"], prefix)}
+
+    # Rebuild the full-tree opt STRUCTURE, then fill every leaf from its
+    # source: non-trunk paths (and the wrapper's count/hyperparams
+    # scalars) exist verbatim in opt_g; trunk paths strip their block
+    # segment and index [s, j] into the stacked opt_s leaf.
+    opt_g, _, _ = make_optimizers(cfg, steps_per_epoch)
+    template = opt_g.init(params_g)
+    rest = {jax.tree_util.keystr(p): leaf for p, leaf
+            in jax.tree_util.tree_flatten_with_path(state.opt_g)[0]}
+    stacked_opt = {jax.tree_util.keystr(p): leaf for p, leaf
+                   in jax.tree_util.tree_flatten_with_path(state.opt_s)[0]}
+    s_b = jax.tree_util.tree_leaves(stacked["params"])[0].shape[:2]
+    per = int(s_b[1])
+
+    def fill(path, zero):
+        key = jax.tree_util.keystr(path)
+        if key in rest:
+            return rest[key]
+        for k in path:
+            name = getattr(k, "key", None)
+            if isinstance(name, str) and name.startswith(prefix):
+                i = int(name[len(prefix):])
+                stripped = key.replace(f"['{name}']", "", 1)
+                return stacked_opt[stripped][i // per, i % per]
+        raise KeyError(f"opt leaf {key} in neither opt_g nor opt_s")
+
+    merged_opt = jax.tree_util.tree_map_with_path(fill, template)
+    return state.replace(
+        params_g=params_g,
+        batch_stats_g=batch_stats_g,
+        quant_g=quant_g,
+        opt_g=merged_opt,
+        pp_stages=None,
+        opt_s=None,
+    )
+
+
+def pp_split_state(state, cfg, mesh: Optional[Mesh] = None,
+                   steps_per_epoch: int = 1,
+                   n_stages: Optional[int] = None,
+                   init_opt: bool = True, place: bool = True):
+    """Move the generator trunk out of a flat TrainState into the
     pipe-sharded ``pp_stages`` stack with its own optimizer state.
 
     The trunk's per-block ``params`` / ``batch_stats`` / ``quant`` entries
     leave ``params_g``/``batch_stats_g``/``quant_g`` (stage weights live
-    only on their stage's devices — the point of PP), ``opt_g`` is
-    re-initialized on the trunk-less tree (intended for training START:
-    fresh Adam state is zeros either way), and ``opt_s`` gets the same
-    optimizer over the stacked stage params. Per-leaf Adam makes the
+    only on their stage's devices — the point of PP); ``opt_s`` gets the
+    same optimizer over the stacked stage params. Per-leaf Adam makes the
     split update trajectory identical to the fused one.
+
+    ``init_opt=True`` (training START): ``opt_g``/``opt_s`` are freshly
+    initialized — fresh Adam state is zeros either way. ``init_opt=False``
+    (the elastic pipe-width migration): the flat state's LIVE optimizer
+    moments are carried — the trunk-less remainder stripped in place, the
+    trunk moments stacked under the same [S, B] law as the params — so a
+    mid-run checkpoint re-expresses at a new width without losing its
+    trajectory. ``n_stages`` defaults to the mesh's pipe width;
+    ``place=False`` skips the device placement (template building for a
+    cross-topology restore needs shapes, not a mesh).
     """
     from p2p_tpu.train.state import make_optimizers
 
     prefix = trunk_prefix(cfg.model)
+    if n_stages is None:
+        n_stages = mesh.shape[PIPE_AXIS]
     variables = {"params": state.params_g}
     if state.batch_stats_g:
         variables["batch_stats"] = state.batch_stats_g
     if state.quant_g:
         variables["quant"] = state.quant_g
-    stacked = place_trunk_pp(
-        stack_trunk(variables, mesh.shape[PIPE_AXIS], prefix=prefix), mesh)
+    stacked = stack_trunk(variables, n_stages, prefix=prefix)
+    if place:
+        stacked = place_trunk_pp(stacked, mesh)
 
     def strip(tree):
         if not tree:
@@ -453,15 +581,23 @@ def pp_split_state(state, cfg, mesh: Mesh, steps_per_epoch: int = 1):
         return {k: v for k, v in tree.items() if not k.startswith(prefix)}
 
     params_rest = strip(state.params_g)
-    # optax transforms are stateless — ONE generator-family optimizer
-    # serves both the trunk-less tree and the stage stack
-    opt_g, _, _ = make_optimizers(cfg, steps_per_epoch)
+    if init_opt:
+        # optax transforms are stateless — ONE generator-family optimizer
+        # serves both the trunk-less tree and the stage stack
+        opt_g, _, _ = make_optimizers(cfg, steps_per_epoch)
+        new_opt_g = opt_g.init(params_rest)
+        new_opt_s = opt_g.init(stacked["params"])
+    else:
+        new_opt_g = _trunk_dict_map(state.opt_g, prefix, strip)
+        new_opt_s = _trunk_dict_map(
+            state.opt_g, prefix,
+            lambda t: _gather_stack(t, prefix, n_stages))
     return state.replace(
         params_g=params_rest,
         batch_stats_g=strip(state.batch_stats_g),
         quant_g=(strip(state.quant_g)
                  if state.quant_g is not None else None),
-        opt_g=opt_g.init(params_rest),
+        opt_g=new_opt_g,
         pp_stages=stacked,
-        opt_s=opt_g.init(stacked["params"]),
+        opt_s=new_opt_s,
     )

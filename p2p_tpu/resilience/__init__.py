@@ -23,6 +23,11 @@ an afterthought per call site. Four pillars:
   :data:`~p2p_tpu.resilience.health.DIVERGED_EXIT_CODE` (76) when the
   ladder is exhausted; plus checkpoint integrity verification and the
   EMA generator (train/checkpoint.py, train/step.py).
+- :mod:`.reshape` — restore-time state migration: the elastic
+  ``migrate`` verdict's transform chain (batch re-basing from cumulative
+  samples, pipe-width trunk restructuring, closed-form TP amax
+  re-calibration, opt-in dtype cast), executed by ``elastic_restore``
+  from both trainers' ``maybe_resume``.
 
 Everything counts through the PR-1 obs registry: ``preemptions_total``,
 ``retry_attempts_total``/``retry_exhausted_total``,

@@ -78,13 +78,38 @@ def _abstract(leaf):
     return ocp.utils.to_shape_dtype_struct(leaf)
 
 
+class SidecarCorrupt(RuntimeError):
+    """Every iterator-state sidecar in scope failed to parse (torn
+    half-writes, bit rot) — the checkpoint directory's recorded topology
+    is unrecoverable. Deliberately an ERROR rather than a None return:
+    a None here would read downstream as "pre-elastic checkpoint,
+    nothing to reconcile" and silently bypass the must-abort topology
+    classification."""
+
+    def __init__(self, directory: str, newest_step: int):
+        self.directory = directory
+        self.newest_step = newest_step
+        super().__init__(
+            f"every checkpoint sidecar under {directory}.aux is "
+            f"torn/unreadable (newest attempted step: {newest_step}) — "
+            "the run's recorded topology cannot be reconciled; inspect "
+            "the .aux directory (restore a sidecar from backup, or "
+            "delete the aux dir to resume with step-derived position "
+            "AND pre-elastic topology semantics)")
+
+
 def peek_topology(directory: str) -> Optional[Dict[str, Any]]:
     """The newest step's recorded topology block from ``<directory>.aux``,
     without constructing a :class:`CheckpointManager` (which would create
     directories). Used by the trainers to enrich mesh-resolve failures on
     relaunch: "your --mesh doesn't fit this slice; the checkpoint was
-    saved on <topology>". None when no sidecar names one (fresh run,
-    pre-elastic checkpoints, unreadable/corrupt sidecars)."""
+    saved on <topology>". None when no sidecar names one (fresh run, or
+    pre-elastic sidecars that parse but record no topology block).
+
+    Raises :class:`SidecarCorrupt` when sidecars EXIST but every one of
+    them fails to parse — an all-torn aux dir must not read as
+    "pre-elastic" (the None a caller would misinterpret as nothing to
+    reconcile)."""
     aux_dir = os.path.abspath(directory) + ".aux"
     try:
         names = os.listdir(aux_dir)
@@ -95,14 +120,18 @@ def peek_topology(directory: str) -> Optional[Dict[str, Any]]:
         stem, dot, ext = n.partition(".")
         if dot and ext == "json" and stem.isdigit():
             steps.append(int(stem))
+    torn = 0
     for s in sorted(steps, reverse=True):
         try:
             with open(os.path.join(aux_dir, f"{s}.json")) as f:
                 topo = json.load(f).get("topology")
         except (OSError, json.JSONDecodeError):
+            torn += 1
             continue
         if topo:
             return topo
+    if steps and torn == len(steps):
+        raise SidecarCorrupt(os.path.abspath(directory), max(steps))
     return None
 
 
@@ -335,6 +364,32 @@ class CheckpointManager:
         print(f"WARNING: checkpoint step {step} failed integrity "
               f"({reason}) — falling back to the previous intact step",
               flush=True)
+
+    def integrity_manifest(self, step: int) -> Optional[Dict[str, Any]]:
+        """The save-time (or migration-regenerated) integrity manifest
+        for ``step`` — {step, algo, leaves: {path: {crc32, shape,
+        dtype}}} — or None when the step predates integrity tracking.
+        The dtype-cast migration (resilience/reshape.py) diffs restored
+        leaves against it to LOG exactly what a cast changed."""
+        return self._read_aux_json(f"{int(step)}.integrity.json")
+
+    def rewrite_integrity(self, step: int, state: Any,
+                          note: str = "") -> None:
+        """Regenerate ``step``'s integrity manifest from ``state`` — the
+        dtype-cast migration epilogue: after an explicit cast the on-disk
+        manifest names the PRE-cast bytes, so verification would silently
+        skip every cast leaf forever; re-deriving it from the post-cast
+        state restores meaningful CRC checks for subsequent restores
+        (which read the same on-disk bytes and cast the same way).
+        No-op on multi-process runs (leaves only partially addressable —
+        same rule as the save-time manifest)."""
+        sums = _leaf_checksums(state)
+        if sums is None:
+            return
+        payload = {"step": int(step), "algo": "crc32", "leaves": sums}
+        if note:
+            payload["migrated"] = note
+        self._write_aux_json(f"{int(step)}.integrity.json", payload)
 
     # -- last-good tracking (the recovery ladder's rollback target) -------
     def mark_good(self, step: int) -> None:

@@ -133,6 +133,8 @@ def trainer_topology(tr) -> Dict:
     from p2p_tpu.core.mesh import mesh_topology
     from p2p_tpu.data.pipeline import loader_kind
 
+    from p2p_tpu.resilience.reshape import pp_width_of
+
     topo = mesh_topology(tr.mesh)
     topo.update({
         "global_batch": int(tr.cfg.data.batch_size),
@@ -142,6 +144,10 @@ def trainer_topology(tr) -> Dict:
         # mid-epoch reshard is only exact under the fallback loader's
         # stride arithmetic — plan_elastic_restore gates on this
         "loader": loader_kind(),
+        # the stacking the state TREE actually carries (1 = flat): the
+        # pipe-width migration's restore template follows this, not the
+        # mesh axis — the CLI trainer runs flat even on a pipe>1 mesh
+        "pp_stages": pp_width_of(tr.state),
     })
     return topo
 
@@ -159,6 +165,14 @@ def save_trainer_ckpt(tr, wait: bool = False) -> int:
         "epoch": tr.epoch,
         "batches_done": step % tr.steps_per_epoch,
         "steps_per_epoch": tr.steps_per_epoch,
+        # cumulative-sample accounting, written on EVERY run (not just
+        # elastic ones): after a global-batch migration the step counter
+        # no longer names a sample position, so these are the ground
+        # truth the batch_rebase transform (resilience/reshape.py)
+        # re-derives position from; pre-PR-11 sidecars fall back to the
+        # step×batch derivation (counted on aux_compat_total)
+        "samples_seen": int(getattr(tr, "_samples_seen", 0)),
+        "epoch_samples_done": int(getattr(tr, "_epoch_samples_done", 0)),
         "aug_seed": tr.cfg.train.seed + tr.epoch
         + getattr(tr, "_seed_jitter", 0),
         # health bookkeeping a relaunch must re-derive: the rollback
@@ -199,6 +213,38 @@ def finish_preempted(tr) -> None:
 _AUX_UNREAD = object()
 
 
+def derive_sample_position(tr, step: int, aux, mid: int) -> int:
+    """Set the trainer's cumulative-sample bookkeeping
+    (``_samples_seen`` / ``_epoch_samples_done`` / ``_resume_skip_samples``)
+    from a restored step's sidecar. A pre-PR-11 sidecar (or a torn one
+    that degraded to None) is missing the sample fields: degrade to the
+    step×batch derivation — exact whenever the run never changed batch —
+    counted on ``aux_compat_total`` + a ``kind="aux_compat"`` record,
+    never an exception. Returns the epoch-sample prefix."""
+    topo = (aux or {}).get("topology") or {}
+    b_saved = int(topo.get("global_batch") or tr.cfg.data.batch_size)
+    ss = (aux or {}).get("samples_seen")
+    es = (aux or {}).get("epoch_samples_done")
+    if ss is None or es is None:
+        tr.obs.counter("aux_compat_total").inc()
+        tr.logger.log(
+            {"kind": "aux_compat", "step": int(step),
+             "missing": [k for k, v in (("samples_seen", ss),
+                                        ("epoch_samples_done", es))
+                         if v is None],
+             "derived_batch": b_saved},
+            force=True,
+        )
+        if ss is None:
+            ss = int(step) * b_saved
+        if es is None:
+            es = int(mid) * b_saved
+    tr._samples_seen = int(ss)
+    tr._epoch_samples_done = int(es)
+    tr._resume_skip_samples = int(es)
+    return int(es)
+
+
 def derive_resume_position(tr, step: int, aux=_AUX_UNREAD):
     """``(done_full_epochs, mid_batches)`` for a restored checkpoint step,
     shared by both trainers' ``maybe_resume``.
@@ -223,8 +269,13 @@ def derive_resume_position(tr, step: int, aux=_AUX_UNREAD):
         # DIFFERENT permutation
         tr._seed_jitter = int(aux["seed_jitter"])
     if aux is not None and aux.get("batches_done") is not None:
+        plan = getattr(tr, "_elastic_plan", None)
+        rebasing = plan is not None and "batch_rebase" in plan.chain
         if int(aux.get("steps_per_epoch", tr.steps_per_epoch)) \
-                != tr.steps_per_epoch:
+                != tr.steps_per_epoch and not rebasing:
+            # a PLANNED batch migration re-bases from samples (reshape.
+            # apply_batch_rebase) — this warning is for the unplanned
+            # drift case (dataset changed under the checkpoint)
             print(
                 f"WARNING: checkpoint step {step} was saved with "
                 f"steps_per_epoch={aux.get('steps_per_epoch')} but this "
@@ -232,7 +283,12 @@ def derive_resume_position(tr, step: int, aux=_AUX_UNREAD):
                 "alignment is not guaranteed (did the dataset or batch "
                 "size change?)", flush=True)
         mid = int(aux["batches_done"])
-        done = (int(step) - mid) // tr.steps_per_epoch
+        # full epochs behind the restored step, in the units the step
+        # counter was WRITTEN in — the sidecar's steps_per_epoch (equal
+        # to this run's except across a batch migration, where this
+        # run's divisor would misplace the epoch boundary)
+        done = (int(step) - mid) // int(
+            aux.get("steps_per_epoch") or tr.steps_per_epoch)
         # the sidecar's aug_seed encodes train.seed + epoch at save time;
         # a different --seed on the relaunch reshuffles the epoch, so the
         # skip below would drop batches of a DIFFERENT permutation —
@@ -248,6 +304,7 @@ def derive_resume_position(tr, step: int, aux=_AUX_UNREAD):
                 "samples. Relaunch with the original --seed for exact "
                 "resume.", flush=True)
     tr._resume_skip = mid
+    derive_sample_position(tr, step, aux, mid)
     if mid:
         tr.logger.log(
             {"kind": "resume", "step": int(step), "epoch": done + 1,
@@ -260,17 +317,23 @@ def derive_resume_position(tr, step: int, aux=_AUX_UNREAD):
 def plan_elastic_restore(tr, step: int, aux):
     """Reconcile the checkpoint's recorded topology with this relaunch's
     BEFORE the restore touches Orbax; shared by both trainers'
-    ``maybe_resume``.
+    ``maybe_resume``. Collective-bearing on >1 process (the plan it
+    returns drives a cross-host Orbax load) — call sites must be
+    host-uniform (collective_consistency's curated list).
 
-    Returns the target-sharding pytree for
-    :meth:`CheckpointManager.restore` — None for a same-topology (or
-    pre-elastic) checkpoint, a rule-derived NamedSharding tree for the
-    NEW mesh when the delta classifies as a compatible reshard. Raises
+    Returns None for a same-topology (or pre-elastic) checkpoint, else
+    an :class:`~p2p_tpu.resilience.reshape.ElasticPlan` that
+    :func:`~p2p_tpu.resilience.reshape.elastic_restore` executes — a
+    plain resharded restore (``reshard``), or a restore THROUGH the
+    named transform chain (``migrate``: batch_rebase / pp_restructure /
+    tp_amax_recalibrate / dtype_cast). Raises
     :class:`~p2p_tpu.core.mesh.TopologyMismatch` (with the saved and
-    current topologies spelled out) on a must-abort delta, on a
-    mid-epoch reshard under the Grain loader (its contiguous-block
-    sharding has no topology-invariant epoch permutation — accounting
-    would silently drift), or on ANY delta under ``--no-elastic``.
+    current topologies spelled out) on a must-abort delta (dtype change
+    without ``--cast_on_restore``, ``int8_delayed`` flip), on a
+    mid-epoch topology change under the Grain loader (its
+    contiguous-block sharding has no topology-invariant epoch
+    permutation — accounting would silently drift), or on ANY delta
+    under ``--no-elastic``.
 
     ``aux`` is the step's already-read sidecar (maybe_resume reads it
     once and threads it through — a torn sidecar must be counted once,
@@ -281,12 +344,16 @@ def plan_elastic_restore(tr, step: int, aux):
         classify_topology_delta,
         describe_topology,
     )
+    from p2p_tpu.resilience.reshape import ElasticPlan
 
+    tr._elastic_plan = None
     saved = (aux or {}).get("topology")
     if not saved:
         # torn/missing sidecar for THIS step: the newest intact sidecar
         # still names the run's layout — a half-written JSON must not
-        # bypass the must-abort classification (global batch, dtype)
+        # bypass the must-abort classification (dtype, int8_delayed).
+        # peek_topology RAISES SidecarCorrupt when every sidecar is torn
+        # (an all-torn aux dir must not read as "pre-elastic").
         from p2p_tpu.train.checkpoint import peek_topology
 
         saved = peek_topology(tr.ckpt.directory)
@@ -298,8 +365,9 @@ def plan_elastic_restore(tr, step: int, aux):
     has_quant = bool(jax.tree_util.tree_leaves(
         tuple(getattr(tr.state, f, None)
               for f in ("quant_g", "quant_d", "quant_c"))))
-    delta = classify_topology_delta(saved, current,
-                                    has_quant_state=has_quant)
+    delta = classify_topology_delta(
+        saved, current, has_quant_state=has_quant,
+        cast_on_restore=tr.cfg.train.cast_on_restore)
     if delta.kind == "same":
         return None
     detail = (f"saved: {describe_topology(saved)}; "
@@ -313,6 +381,19 @@ def plan_elastic_restore(tr, step: int, aux):
             f"topology changed with elastic resume disabled — "
             f"{delta.reason} ({detail}); relaunch on the original "
             "topology, or drop --no-elastic to reshard")
+    if "pp_restructure" in delta.chain and "pp_stages" not in saved \
+            and int((saved.get("mesh") or {}).get("pipe", 1) or 1) > 1:
+        # a pre-PR-11 sidecar cannot name the trunk stacking the
+        # checkpoint tree actually carries (the CLI trainer runs flat
+        # even on a pipe>1 mesh; the PP step runs stacked) — guessing
+        # flat would fail deep inside Orbax with an opaque structure
+        # mismatch instead of this diagnosis
+        raise TopologyMismatch(
+            f"cannot migrate the pipe width: the checkpoint's sidecar "
+            f"predates the pp_stages record, so the saved trunk "
+            f"stacking is unknown ({detail}); relaunch at the original "
+            "pipe axis once (its next checkpoint records the stacking), "
+            "then change the width")
     mid = int(aux["batches_done"]) if aux and \
         aux.get("batches_done") is not None \
         else int(step) % tr.steps_per_epoch
@@ -329,26 +410,30 @@ def plan_elastic_restore(tr, step: int, aux):
     tr.logger.log(
         {"kind": "elastic_resume", "step": int(step),
          "decision": delta.kind, "reason": delta.reason,
+         "chain": list(delta.chain),
          "saved": saved, "current": current},
         force=True,
     )
-    print(f"elastic resume: {delta.reason} — resharding the step-{step} "
-          f"checkpoint onto the current topology ({detail})", flush=True)
-    if tr.mesh is None:
-        return None  # single-device template: its layout is the target
-    from p2p_tpu.parallel.rules import state_target_shardings
+    verb = ("migrating" if delta.kind == "migrate" else "resharding")
+    chain_note = (f" via {'+'.join(delta.chain)}" if delta.chain else "")
+    print(f"elastic resume: {delta.reason} — {verb} the step-{step} "
+          f"checkpoint onto the current topology{chain_note} ({detail})",
+          flush=True)
+    plan = ElasticPlan(kind=delta.kind, chain=delta.chain,
+                       reason=delta.reason, saved=saved, current=current)
+    tr._elastic_plan = plan
+    return plan
 
-    return state_target_shardings(
-        tr.state, tr.mesh, tp_min_ch=tr.cfg.parallel.tp_min_ch)
 
-
-def finish_elastic_restore(tr, step: int, shardings) -> None:
-    """Post-restore accounting for a resharded resume: one auditable
-    record naming the count (the CI elastic smoke asserts on it)."""
-    if shardings is None:
+def finish_elastic_restore(tr, step: int, plan) -> None:
+    """Post-restore accounting for a resharded/migrated resume: one
+    auditable record naming the count (the CI elastic smoke asserts on
+    it)."""
+    if plan is None or tr.mesh is None:
         return
     tr.logger.log(
         {"kind": "resharded_restore", "step": int(step),
+         "decision": plan.kind, "chain": list(plan.chain),
          "resharded_restore_total":
              tr.obs.counter("resharded_restore_total").value},
         force=True,
@@ -366,11 +451,17 @@ def build_trainer_mesh(cfg, workdir: str):
     try:
         return make_mesh(cfg.parallel.mesh)
     except ValueError as e:
-        from p2p_tpu.train.checkpoint import peek_topology
+        from p2p_tpu.train.checkpoint import SidecarCorrupt, peek_topology
 
         ckpt_dir = os.path.join(
             workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name)
-        saved = peek_topology(ckpt_dir)
+        try:
+            saved = peek_topology(ckpt_dir)
+        except SidecarCorrupt:
+            # enrichment only — the mesh resolve failure is the real
+            # error here; the corrupt-sidecar diagnosis surfaces on the
+            # resume path (plan_elastic_restore) where it is actionable
+            saved = None
         if saved is not None:
             raise ValueError(
                 f"{e} [relaunch context: the checkpoint under {ckpt_dir} "
@@ -460,6 +551,16 @@ def init_trainer_health(tr) -> None:
     tr._base_lr_scale = 1.0
     tr._applied_lr_scale = 1.0
     tr._host_step = 0
+    # cumulative-sample accounting (host mirrors, like _host_step): the
+    # basis the elastic batch_rebase migration re-derives position from;
+    # written into every checkpoint sidecar
+    tr._samples_seen = 0
+    tr._epoch_samples_done = 0
+    tr._resume_skip_samples = 0
+    # elastic-migration transient state (resilience/reshape.py)
+    tr._elastic_plan = None
+    tr._quant_freeze_remaining = 0
+    tr._quant_frozen = None
     if tr.cfg.health.enabled:
         from p2p_tpu.resilience.health import TrainingHealth
 
@@ -486,6 +587,10 @@ def queue_health_observation(tr, metrics_dev, k: int) -> None:
     consume the PREVIOUS dispatch's. ``metrics_dev`` is the per-step
     stacked tree for a scanned dispatch (k > 1) or the single step's
     metrics (k == 1)."""
+    # sample accounting rides the same host mirror: k steps consumed
+    # k × global_batch samples of the epoch permutation
+    tr._samples_seen += k * tr.cfg.data.batch_size
+    tr._epoch_samples_done += k * tr.cfg.data.batch_size
     if tr.health is None:
         tr._host_step += k
         return
@@ -551,12 +656,47 @@ def perform_rollback(tr) -> None:
     aux = tr.ckpt.restore_aux(int(target))
     if aux is not None and aux.get("batches_done") is not None:
         mid = int(aux["batches_done"])
-        done = (int(target) - mid) // tr.steps_per_epoch
+        # divisor in the units the target's step counter was WRITTEN in
+        # (its sidecar's steps_per_epoch): a rollback can land on a
+        # checkpoint from BEFORE a batch migration, whose basis differs
+        done = (int(target) - mid) // int(
+            aux.get("steps_per_epoch") or tr.steps_per_epoch)
     tr.epoch = done + 1
     tr._resume_skip = mid
+    # sample accounting must follow the weights actually restored (the
+    # sidecar fields are exact; a pre-PR-11 target degrades to
+    # step×batch at the SAVED batch, counted)
+    derive_sample_position(tr, int(target), aux, mid)
+    host_step = int(target)
+    b_saved = int(((aux or {}).get("topology") or {})
+                  .get("global_batch") or tr.cfg.data.batch_size)
+    if b_saved != int(tr.cfg.data.batch_size):
+        # the target predates a batch migration: its step counter is on
+        # the OLD batch basis — re-base to samples exactly as the resume
+        # path does (reshape.apply_batch_rebase's law), or the LR
+        # schedule/epoch boundaries silently desync for the rest of the
+        # run
+        from p2p_tpu.resilience.reshape import rebase_step_counters
+
+        b_new = int(tr.cfg.data.batch_size)
+        es = int(tr._epoch_samples_done)
+        host_step = done * tr.steps_per_epoch + -(-es // b_new)
+        tr.state = rebase_step_counters(tr.state, host_step)
+        tr._resume_skip = es // b_new
+        tr.logger.log(
+            {"kind": "batch_rebase", "step": int(target),
+             "rebased_step": int(host_step), "batch_saved": b_saved,
+             "batch_current": b_new, "samples_seen": tr._samples_seen,
+             "epoch_samples_done": es,
+             "steps_per_epoch": tr.steps_per_epoch, "on": "rollback"},
+            force=True,
+        )
+    # a recalibration freeze window must not re-pin post-rollback scales
+    tr._quant_freeze_remaining = 0
+    tr._quant_frozen = None
     tr._seed_jitter += 1000003  # new shuffle permutation from here on
     tr._pending_health = None
-    tr._host_step = int(target)
+    tr._host_step = host_step
     tr.health.after_rollback(cur_step, int(target))
     # the restore overwrote the device lr_scale with the checkpoint's
     # value; rather than fetching it back (a host sync, formerly waived
@@ -943,16 +1083,26 @@ class Trainer:
         step = self.ckpt.latest_step()
         if step is None:
             return False
+        return self._resume_from(int(step))
+
+    def _resume_from(self, step: int) -> bool:
         # the step's sidecar, read ONCE for every consumer below (a torn
         # one must bump aux_corrupt_total once, not once per reader)
         aux = self.ckpt.restore_aux(int(step))
         # Elastic relaunch: reconcile the sidecar's recorded topology with
         # this launch's BEFORE touching Orbax — a compatible delta restores
-        # resharded onto the new mesh; an incompatible one aborts with the
-        # two topologies spelled out instead of a deep restore error.
-        shardings = plan_elastic_restore(self, int(step), aux)
+        # resharded onto the new mesh, a migrate delta restores THROUGH
+        # the reshape transform chain (resilience/reshape.py), and an
+        # incompatible one aborts with the two topologies spelled out
+        # instead of a deep restore error.
+        from p2p_tpu.resilience.reshape import (
+            apply_batch_rebase,
+            elastic_restore,
+        )
+
+        plan = plan_elastic_restore(self, int(step), aux)
         try:
-            self.state = self.ckpt.restore(self.state, shardings=shardings)
+            self.state = elastic_restore(self, int(step), plan)
         except CheckpointCorrupt as e:
             if self.cfg.health.ema_decay is not None:
                 # the likeliest cause: --ema_decay was ADDED over a
@@ -972,12 +1122,20 @@ class Trainer:
                 and int(self.ckpt.last_restored_step) != int(step):
             step = self.ckpt.last_restored_step
             aux = self.ckpt.restore_aux(int(step))
-        finish_elastic_restore(self, int(step), shardings)
+        finish_elastic_restore(self, int(step), plan)
         # Exact-step resume: a mid-epoch (preemption) checkpoint re-enters
         # its epoch at batch `mid` — the loader skips exactly the batches
         # the killed run consumed (same shuffle: the epoch seed is a pure
         # function of the epoch label).
         done, mid = derive_resume_position(self, int(step), aux=aux)
+        host_step = int(step)
+        if plan is not None and "batch_rebase" in plan.chain:
+            # global-batch migration: position/step/LR basis re-derive
+            # from cumulative SAMPLES; the device step + optimizer counts
+            # are rebased so `step % steps_per_epoch` keeps naming epoch
+            # boundaries under the new batch
+            done, host_step = apply_batch_rebase(
+                self, int(step), aux, plan, done, mid)
         # --epoch_count N means "continue labeling at epoch N" (reference
         # train.py:137,253-255); without it the restored step names the
         # epoch. `1 + done` covers both boundary and mid-epoch resumes: a
@@ -1021,11 +1179,12 @@ class Trainer:
         # the health LR bookkeeping must agree with the restored scale
         self._base_lr_scale = float(np.asarray(self.state.lr_scale))
         self._applied_lr_scale = self._base_lr_scale
-        self._host_step = int(step)
+        self._host_step = host_step
         return True
 
     def train_epoch(self, seed: Optional[int] = None,
-                    skip_batches: int = 0) -> Dict[str, float]:
+                    skip_batches: int = 0,
+                    skip_samples: int = 0) -> Dict[str, float]:
         cfg = self.cfg
         # Per-epoch entropy (shuffle order + augmentation crops),
         # reproducible across same-seed runs. Defaults to the current
@@ -1046,7 +1205,8 @@ class Trainer:
         loader = make_loader(
             self.train_ds, self.local_bs, shuffle=True,
             seed=cfg.train.seed + seed, num_workers=workers,
-            skip_batches=skip_batches, registry=self.obs,
+            skip_batches=skip_batches, skip_samples=skip_samples,
+            registry=self.obs,
         )
         # Keep a device-side running sum (no host sync mid-epoch, no buffer
         # pile-up) and transfer ONCE at epoch end, so averages cover EVERY
@@ -1096,6 +1256,12 @@ class Trainer:
             # one (already retired — no fence); scanned dispatches feed
             # their per-step stacked metrics so no step escapes
             queue_health_observation(self, metrics if k > 1 else last, k)
+            if self._quant_freeze_remaining:
+                # --recalibrate_steps warmup after a TP amax migration:
+                # re-pin the migrated scales (resilience/reshape.py)
+                from p2p_tpu.resilience.reshape import hold_frozen_quant
+
+                hold_frozen_quant(self)
             if cfg.debug.check_finite:
                 # host-side guard (fences this dispatch): the nonfinite
                 # record lands in the metrics stream BEFORE the raise.
@@ -1132,7 +1298,11 @@ class Trainer:
                 host = {kk: float(v) for kk, v in last.items()}
                 self.logger.log(
                     {"kind": "train", "epoch": self.epoch,
-                     "step": int(self.state.step), **host},
+                     "step": int(self.state.step),
+                     # cumulative samples through this dispatch — the
+                     # evidence the cross-BATCH elastic rehearsals tile
+                     # for gaplessness (a host counter, no device sync)
+                     "samples": int(self._samples_seen), **host},
                     force=True,
                 )
 
@@ -1358,13 +1528,17 @@ class Trainer:
             while self.epoch <= nepoch:
                 t0 = time.time()
                 # exact-step resume: the first epoch after a mid-epoch
-                # restore skips exactly the batches the killed run consumed
-                skip = self._resume_skip
+                # restore skips exactly the SAMPLES the killed run
+                # consumed (sample-granular, so a batch-change migration's
+                # old-batch prefix still tiles exactly; = batches × batch
+                # on the ordinary path)
+                skip_s = self._resume_skip_samples
+                self._resume_skip_samples = 0
                 self._resume_skip = 0
                 rollback = False
                 with self.spans.span("epoch", epoch=self.epoch):
                     train_metrics = self.train_epoch(seed=self.epoch,
-                                                     skip_batches=skip)
+                                                     skip_samples=skip_s)
                     record = {"epoch": self.epoch, "sec": time.time() - t0,
                               **train_metrics}
                     lr = self.current_lr()
@@ -1386,6 +1560,9 @@ class Trainer:
                     # record (the diverged partial epoch didn't complete)
                     perform_rollback(self)
                     continue
+                # epoch completed: the in-epoch sample counter re-arms
+                # (the cumulative _samples_seen keeps growing)
+                self._epoch_samples_done = 0
                 history.append(record)
                 # epoch summary (incl. lr) into the metrics stream — the
                 # jsonl otherwise only carries per-step and eval records, so

@@ -186,23 +186,38 @@ class VideoTrainer:
         step = self.ckpt.latest_step()
         if step is None:
             return False
+        return self._resume_from(int(step))
+
+    def _resume_from(self, step: int) -> bool:
         # the step's sidecar, read ONCE for every consumer below
         aux = self.ckpt.restore_aux(int(step))
         # elastic relaunch: reconcile recorded vs current topology first
-        # (cf. Trainer.maybe_resume) — reshard compatible deltas, abort
-        # incompatible ones with both topologies named
-        shardings = plan_elastic_restore(self, int(step), aux)
-        self.state = self.ckpt.restore(self.state, shardings=shardings)
+        # (cf. Trainer.maybe_resume) — reshard compatible deltas, migrate
+        # transformable ones (resilience/reshape.py), abort the rest with
+        # both topologies named
+        from p2p_tpu.resilience.reshape import (
+            apply_batch_rebase,
+            elastic_restore,
+        )
+
+        plan = plan_elastic_restore(self, int(step), aux)
+        self.state = elastic_restore(self, int(step), plan)
         # integrity fallback may have restored an OLDER intact step
         if self.ckpt.last_restored_step is not None \
                 and int(self.ckpt.last_restored_step) != int(step):
             step = self.ckpt.last_restored_step
             aux = self.ckpt.restore_aux(int(step))
-        finish_elastic_restore(self, int(step), shardings)
+        finish_elastic_restore(self, int(step), plan)
         # exact-step resume (shared with Trainer.maybe_resume): a
         # mid-epoch (preemption) checkpoint re-enters its epoch at
         # clip-batch `mid`
         done, mid = derive_resume_position(self, int(step), aux=aux)
+        host_step = int(step)
+        if plan is not None and "batch_rebase" in plan.chain:
+            # global-batch migration: re-derive position from samples
+            # (cf. Trainer._resume_from)
+            done, host_step = apply_batch_rebase(
+                self, int(step), aux, plan, done, mid)
         self.epoch = max(self.cfg.train.epoch_count, 1 + done)
         # Renormalize the schedule's epoch offset against the restored
         # step (see Trainer.maybe_resume for the double-offset analysis;
@@ -226,11 +241,12 @@ class VideoTrainer:
             self.plateau.scale = float(np.asarray(self.state.lr_scale))
         self._base_lr_scale = float(np.asarray(self.state.lr_scale))
         self._applied_lr_scale = self._base_lr_scale
-        self._host_step = int(step)
+        self._host_step = host_step
         return True
 
     def train_epoch(self, seed: int = 0,
-                    skip_batches: int = 0) -> Dict[str, float]:
+                    skip_batches: int = 0,
+                    skip_samples: int = 0) -> Dict[str, float]:
         cfg = self.cfg
         # rollback perturbation (perform_rollback) — cf. Trainer.train_epoch
         seed = seed + getattr(self, "_seed_jitter", 0)
@@ -238,7 +254,8 @@ class VideoTrainer:
             self.train_ds, self.local_bs, shuffle=True,
             seed=cfg.train.seed + seed,
             num_workers=cfg.data.threads if len(self.train_ds) > 64 else 0,
-            skip_batches=skip_batches, registry=self.obs,
+            skip_batches=skip_batches, skip_samples=skip_samples,
+            registry=self.obs,
         )
         sums = None
         count = 0
@@ -296,6 +313,7 @@ class VideoTrainer:
                 self.logger.log(
                     {"kind": "train", "epoch": self.epoch,
                      "step": int(self.state.step),
+                     "samples": int(self._samples_seen),
                      **{kk: float(v) for kk, v in last.items()}},
                     force=True,
                 )
@@ -441,13 +459,14 @@ class VideoTrainer:
         owned_guard = acquire_preempt_guard(self)
         try:
             while self.epoch <= nepoch:
-                skip = self._resume_skip
+                skip_s = self._resume_skip_samples
+                self._resume_skip_samples = 0
                 self._resume_skip = 0
                 rollback = False
                 with self.spans.span("epoch", epoch=self.epoch):
                     record = {"epoch": self.epoch,
                               **self.train_epoch(seed=self.epoch,
-                                                 skip_batches=skip)}
+                                                 skip_samples=skip_s)}
                     rollback = (self.health is not None
                                 and self.health.rollback_pending)
                     if cfg.train.eval_every_epoch and not self._preempted \
@@ -459,6 +478,8 @@ class VideoTrainer:
                     # ladder rung 3 (cf. Trainer.fit)
                     perform_rollback(self)
                     continue
+                # epoch completed: in-epoch sample counter re-arms
+                self._epoch_samples_done = 0
                 history.append(record)
                 self.logger.log({"kind": "epoch", **record}, force=True)
                 self.memwatch.sample(self.logger)

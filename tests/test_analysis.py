@@ -629,6 +629,30 @@ def _clint(relpath, src):
     return lint_collective_source(relpath, src)
 
 
+def test_elastic_restore_entry_points_are_collective_bearing():
+    """PR-11 satellite: the migrate verdict's restore path is on the
+    curated collective-bearing list — a divergent call site of the plan
+    or the restore (which executes the transform chain) is a finding,
+    so the new restore-time collectives stay under the analyzer."""
+    from p2p_tpu.analysis.collective_consistency import COLLECTIVE_BEARING
+    from p2p_tpu.resilience.reshape import RESHAPE_TRANSFORMS
+
+    assert {"plan_elastic_restore", "elastic_restore"} <= COLLECTIVE_BEARING
+    # the chain names the classifier may emit, in one place — the list's
+    # comment block documents exactly these
+    assert RESHAPE_TRANSFORMS == ("batch_rebase", "pp_restructure",
+                                  "tp_amax_recalibrate", "dtype_cast")
+    src = (
+        "def resume(tr, step, aux):\n"
+        "    if tr.flaky_local_condition:\n"
+        "        plan = plan_elastic_restore(tr, step, aux)\n"
+        "        tr.state = elastic_restore(tr, step, plan)\n"
+    )
+    found = _clint("train/foo.py", src)
+    assert {f.rule for f in found} == {"collective-divergent-branch"}
+    assert len(found) == 2
+
+
 def test_collective_divergent_branch_fixture():
     src = (
         "import jax\n"

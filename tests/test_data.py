@@ -390,6 +390,124 @@ def test_skip_rederived_after_process_count_change_is_gapless():
                     "replayed or dropped samples across the topology change")
 
 
+def _consumed_samples_by(perm, global_bs, n_proc, skip_samples=0,
+                         drop_remainder=True):
+    """Samples the relaunch consumes at ``global_bs`` after dropping the
+    flat prefix ``[0, skip_samples)`` — the batch-change resume's
+    production arithmetic (shard_epoch_indices skip_samples)."""
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    local_bs = global_bs // n_proc
+    out = []
+    for pid in range(n_proc):
+        local = shard_epoch_indices(
+            np.asarray(perm), local_bs, skip_samples=skip_samples,
+            n_proc=n_proc, pid=pid, drop_remainder=drop_remainder)
+        if drop_remainder:
+            # the loader's batcher drops the final partial local batch
+            local = local[: (len(local) // local_bs) * local_bs]
+        out.extend(local)
+    return out
+
+
+def test_mid_epoch_batch_change_preserves_consumed_prefix_law():
+    """PR-11 property pin (the batch_rebase migration's data law): a run
+    that consumed ``mid`` batches of B_old, relaunched at B_new with the
+    sample-granular skip, yields old-batch prefix ∪ new-batch suffix =
+    an EXACT prefix of the epoch permutation — no gap, no dup — for
+    unaligned prefixes (B_new ∤ mid·B_old), changed process counts, and
+    the uneven dataset tail."""
+    rng = np.random.default_rng(23)
+    n = 37                       # uneven tail
+    perm = rng.permutation(n)
+    for b_old, p_old in ((6, 2), (4, 1), (6, 3)):
+        spe_old = n // b_old
+        for b_new, p_new in ((4, 2), (3, 1), (8, 2), (5, 1), (6, 2)):
+            for mid in (0, 1, 2, spe_old - 1):
+                before = _consumed_by(perm, b_old, p_old, until=mid)
+                s = mid * b_old
+                after = _consumed_samples_by(perm, b_new, p_new,
+                                             skip_samples=s)
+                usable = n - (n % p_new if p_new > 1 else 0)
+                # prefix-steps + suffix-batches must equal the epoch's
+                # topology-invariant step count: the loader truncates to
+                # usable//B − ceil(S/B) (matching apply_batch_rebase's
+                # ceil-charged step re-base), NOT a (usable−S)//B floor
+                n_b = max(0, usable // b_new - -(-s // b_new))
+                assert len(after) == n_b * b_new, (
+                    f"host batch counts disagree at B {b_old}->{b_new} "
+                    f"p {p_old}->{p_new} mid={mid}")
+                if s <= usable:
+                    assert -(-s // b_new) + n_b == usable // b_new
+                got = sorted(before + after)
+                want = sorted(perm[: s + n_b * b_new].tolist())
+                assert got == want, (
+                    f"gap/dup across batch change {b_old}->{b_new} "
+                    f"(p {p_old}->{p_new}, mid={mid})")
+
+
+def test_batch_change_suffix_batches_tile_flat_windows():
+    """Stronger than the union law: after an UNALIGNED sample skip, the
+    relaunch's global batch i is exactly the flat permutation window
+    [S + i·B_new, S + (i+1)·B_new) — every length-B window holds exactly
+    local_bs members of each host's congruence class."""
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    rng = np.random.default_rng(29)
+    n, b_old, b_new, n_proc = 48, 6, 8, 2
+    perm = rng.permutation(n)
+    s = 3 * b_old                # 18: NOT a multiple of b_new=8
+    local_bs = b_new // n_proc
+    locals_ = [shard_epoch_indices(perm, local_bs, skip_samples=s,
+                                   n_proc=n_proc, pid=pid)
+               for pid in range(n_proc)]
+    n_b = (n - s) // b_new
+    assert all(len(lo) == n_b * local_bs for lo in locals_)
+    for i in range(n_b):
+        got = sorted(
+            v for lo in locals_ for v in lo[i * local_bs:(i + 1) * local_bs])
+        want = sorted(perm[s + i * b_new: s + (i + 1) * b_new].tolist())
+        assert got == want, f"batch {i} is not the flat window"
+
+
+def test_skip_samples_aligned_equals_skip_batches_bitwise():
+    """The ordinary (same-batch) resume moved to the sample-granular
+    skip: with S = mid·B the two forms are the SAME arithmetic, per host,
+    in order — the bitwise exact-resume pins ride on this identity."""
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    rng = np.random.default_rng(31)
+    perm = rng.permutation(41)
+    for n_proc in (1, 2, 4):
+        local_bs = 8 // n_proc
+        for mid in (0, 1, 3):
+            for pid in range(n_proc):
+                a = shard_epoch_indices(perm, local_bs, skip_batches=mid,
+                                        n_proc=n_proc, pid=pid)
+                b = shard_epoch_indices(perm, local_bs,
+                                        skip_samples=mid * 8,
+                                        n_proc=n_proc, pid=pid)
+                # the sample form may additionally trim the tail to the
+                # global batch floor — identical on the batch-aligned
+                # part (all the loader ever yields), same batch count
+                n_b = min(len(a), len(b)) // local_bs
+                assert a[: n_b * local_bs] == b[: n_b * local_bs]
+                assert len(a) // local_bs == len(b) // local_bs == n_b
+
+
+def test_skip_samples_no_drop_remainder_covers_exact_tail():
+    """drop_remainder=False (single-host): the sample skip hands back
+    EXACTLY the unconsumed tail, partial final batch included."""
+    from p2p_tpu.data.pipeline import shard_epoch_indices
+
+    perm = np.arange(11)
+    got = shard_epoch_indices(perm, 2, skip_samples=5,
+                              n_proc=1, pid=0, drop_remainder=False)
+    assert got == list(range(5, 11))
+    with pytest.raises(ValueError, match="not both"):
+        shard_epoch_indices(perm, 2, skip_batches=1, skip_samples=2)
+
+
 def test_shard_epoch_indices_per_host_batch_floor_is_topology_invariant():
     """Every host gets exactly floor(n/B) full local batches regardless of
     the process count (writing n = q*B + r with r < B: the shard is

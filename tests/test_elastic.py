@@ -68,27 +68,78 @@ def test_classify_capacity_deltas_reshard(over):
 
 
 @pytest.mark.parametrize("over,needle", [
-    ({"global_batch": 4}, "--batch_size"),
     ({"mixed_precision": False}, "precision"),
-    ({"moment_dtype": "bfloat16"}, "--moment_dtype"),
+    ({"moment_dtype": "bfloat16"}, "--cast_on_restore"),
     ({"int8_delayed": True}, "--int8_delayed"),
-    ({"mesh": {"data": 2, "spatial": 1, "time": 1, "model": 1, "pipe": 2}},
-     "pipeline-parallel"),
 ])
-def test_classify_semantic_deltas_abort(over, needle):
+def test_classify_unreconcilable_deltas_abort(over, needle):
+    """The residual must-abort set: dtype policy WITHOUT the cast opt-in
+    (silent Orbax cast), and int8_delayed on/off (the TrainState TREE
+    differs — no cast reconciles a structure change)."""
     d = classify_topology_delta(_topo(), _topo(**over))
     assert d.kind == "abort", d
     assert needle in d.reason  # the reason must be actionable
 
 
-def test_classify_tp_width_change_aborts_only_under_quant_state():
+@pytest.mark.parametrize("over,transform", [
+    ({"global_batch": 4}, "batch_rebase"),
+    ({"mesh": {"data": 2, "spatial": 1, "time": 1, "model": 1, "pipe": 2}},
+     "pp_restructure"),
+])
+def test_classify_migratable_deltas_return_chain(over, transform):
+    """PR-11 matrix: global-batch and pipe-width deltas are no longer
+    aborts — they classify ``migrate`` naming the transform chain."""
+    d = classify_topology_delta(_topo(), _topo(**over))
+    assert d.kind == "migrate", d
+    assert d.chain == (transform,)
+
+
+def test_classify_dtype_delta_migrates_only_with_cast_opt_in():
+    new = _topo(moment_dtype="bfloat16")
+    assert classify_topology_delta(_topo(), new).kind == "abort"
+    d = classify_topology_delta(_topo(), new, cast_on_restore=True)
+    assert d.kind == "migrate" and d.chain == ("dtype_cast",)
+    # int8_delayed stays abort even WITH the cast opt-in: tree structure
+    d2 = classify_topology_delta(_topo(), _topo(int8_delayed=True),
+                                 cast_on_restore=True)
+    assert d2.kind == "abort" and "--int8_delayed" in d2.reason
+
+
+def test_classify_combined_migrations_chain_in_order():
+    """Batch + pipe + dtype deltas in one relaunch: one migrate verdict,
+    every transform named, application order stable."""
+    new = _topo(global_batch=4, moment_dtype="bfloat16",
+                mesh={"data": 1, "spatial": 1, "time": 1, "model": 1,
+                      "pipe": 2})
+    d = classify_topology_delta(_topo(), new, cast_on_restore=True)
+    assert d.kind == "migrate"
+    assert d.chain == ("batch_rebase", "dtype_cast", "pp_restructure")
+    # the mesh reshard component rides along in the reason
+    assert "topology delta" in d.reason
+
+
+def test_classify_tp_width_change_migrates_under_quant_state():
     new = _topo(mesh={"data": 2, "spatial": 1, "time": 1, "model": 2,
                       "pipe": 1})
     # no amax state: the Megatron layout re-derives from rules — reshard
     assert classify_topology_delta(_topo(), new).kind == "reshard"
-    # delayed-int8 amax state is calibrated per shard width — abort
+    # delayed-int8 amax state remaps by the closed-form width law
     d = classify_topology_delta(_topo(), new, has_quant_state=True)
-    assert d.kind == "abort" and "tensor-parallel" in d.reason
+    assert d.kind == "migrate" and d.chain == ("tp_amax_recalibrate",)
+    assert "tensor-parallel" in d.reason
+
+
+def test_classify_moment_dtype_none_is_float32():
+    """None IS the f32 default (train/state.py make_optimizers): an
+    explicit --moment_dtype float32 against an unset save is a spelling
+    difference, not a cast — it must not be a delta at all (and must
+    never reach the reinit moment policy)."""
+    assert classify_topology_delta(
+        _topo(moment_dtype=None), _topo(moment_dtype="float32")).kind \
+        == "same"
+    assert classify_topology_delta(
+        _topo(moment_dtype="float32"), _topo(moment_dtype=None)).kind \
+        == "same"
 
 
 def test_classify_missing_keys_are_forward_compatible():
@@ -98,7 +149,7 @@ def test_classify_missing_keys_are_forward_compatible():
     assert classify_topology_delta({"global_batch": 8}, _topo()).kind \
         == "same"
     assert classify_topology_delta({"global_batch": 2}, _topo()).kind \
-        == "abort"
+        == "migrate"
 
 
 def test_mesh_topology_and_describe():
@@ -389,21 +440,86 @@ def test_no_elastic_flag_restores_strict_contract(_preempted_run):
         tr.close()
 
 
-def test_global_batch_delta_aborts_resume(_preempted_run):
-    """Sample accounting cannot survive a batch-size change — the abort
-    must name both topologies and the fix."""
+def test_global_batch_migration_rebases_and_completes(_preempted_run):
+    """PR-11: a batch-size change is a MIGRATION, not an abort. The
+    step-3 checkpoint (bs=4, spe=2, epoch-2 batch 1 done = 4 samples
+    into epoch 2) resumed at bs=2 (spe=4) must re-base the step counter
+    to samples/new-batch (done·spe_new + ceil(4/2) = 6), re-skip the
+    4-sample epoch prefix sample-exactly, and finish the run with
+    gapless cumulative-sample accounting."""
     from p2p_tpu.train.loop import Trainer
 
     root, wd = _preempted_run
     tr = Trainer(_elastic_cfg(2, batch=2), data_root=root, workdir=wd)
     try:
-        with pytest.raises(TopologyMismatch) as ei:
+        assert tr.maybe_resume()
+        # position re-derived from samples: 1 full epoch (8 samples) + 4
+        # samples of epoch 2 → rebased step 6 of the 4-step epoch grid
+        assert int(np.asarray(jax.device_get(tr.state.step))) == 6
+        # optimizer counts follow the rebased basis (LR schedule input)
+        assert int(np.asarray(jax.device_get(
+            tr.state.opt_g.count))) == 6
+        assert tr._samples_seen == 12
+        assert tr._resume_skip_samples == 4
+        assert tr.epoch == 2
+        tr.fit()
+    finally:
+        tr.close()
+    # epoch 2's remaining (8 - 4) samples consumed in 2 new-batch steps
+    assert int(np.asarray(jax.device_get(tr.state.step))) == 8
+    assert tr._samples_seen == 16
+
+    recs = _records(os.path.join(wd, "metrics_elastic.jsonl"))
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[0]["decision"] == "migrate"
+    assert el[0]["chain"] == ["batch_rebase"]
+    rb = [r for r in recs if r.get("kind") == "batch_rebase"]
+    assert rb and rb[0]["rebased_step"] == 6
+    assert rb[0]["batch_saved"] == 4 and rb[0]["batch_current"] == 2
+    assert rb[0]["samples_seen"] == 12
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    # exactly ONE completed-epoch record for epoch 2 across both runs
+    assert [int(r["epoch"]) for r in epochs].count(2) == 1
+
+
+def test_rollback_to_pre_migration_checkpoint_rebases(_preempted_run):
+    """Recovery-ladder rung 3 after a batch migration: a rollback target
+    saved on the OLD batch basis must re-base the restored step/optimizer
+    counters to samples exactly as the resume path does — otherwise the
+    LR schedule and epoch boundaries silently desync for the rest of the
+    run."""
+    from p2p_tpu.train.loop import Trainer, perform_rollback
+
+    root, wd = _preempted_run
+    tr = Trainer(_elastic_cfg(2, batch=2), data_root=root, workdir=wd)
+    try:
+        assert tr.maybe_resume()
+        # rung 3 fires before any new-basis checkpoint exists: the only
+        # target is the dead run's step-3 (bs=4 basis) checkpoint
+        perform_rollback(tr)
+        assert tr._host_step == 6
+        assert int(np.asarray(jax.device_get(tr.state.step))) == 6
+        assert int(np.asarray(jax.device_get(tr.state.opt_g.count))) == 6
+        assert tr.epoch == 2 and tr._resume_skip_samples == 4
+    finally:
+        tr.close()
+    recs = _records(os.path.join(wd, "metrics_elastic.jsonl"))
+    rb = [r for r in recs if r.get("kind") == "batch_rebase"
+          and r.get("on") == "rollback"]
+    assert rb and rb[0]["rebased_step"] == 6 and rb[0]["batch_saved"] == 4
+
+
+def test_batch_migration_respects_no_elastic(_preempted_run):
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+    tr = Trainer(_elastic_cfg(2, batch=2, elastic=False),
+                 data_root=root, workdir=wd)
+    try:
+        with pytest.raises(TopologyMismatch, match="--no-elastic"):
             tr.maybe_resume()
     finally:
         tr.close()
-    msg = str(ei.value)
-    assert "--batch_size" in msg
-    assert "saved:" in msg and "current:" in msg
 
 
 def _aux_path(wd, step=3):
@@ -441,17 +557,194 @@ def test_torn_sidecar_still_reconciles_via_older_sidecar(_preempted_run):
     from p2p_tpu.train.loop import Trainer
 
     root, wd = _preempted_run
-    # an older intact sidecar recording an INCOMPATIBLE global batch
+    # an older intact sidecar recording an INCOMPATIBLE dtype policy
+    # (batch deltas migrate since PR 11 — dtype without --cast_on_restore
+    # is still the hard abort)
     with open(_aux_path(wd, 2), "w") as f:
-        json.dump({"step": 2, "topology": {"global_batch": 8}}, f)
+        json.dump({"step": 2,
+                   "topology": {"moment_dtype": "bfloat16"}}, f)
     # tear the restored step's sidecar mid-token
     with open(_aux_path(wd, 3), "w") as f:
         f.write('{"step": 3, "topolo')
     tr = Trainer(_elastic_cfg(4), data_root=root, workdir=wd)
     try:
-        with pytest.raises(TopologyMismatch, match="--batch_size"):
+        with pytest.raises(TopologyMismatch, match="--cast_on_restore"):
             tr.maybe_resume()
         assert tr.obs.counter("aux_corrupt_total").value == 1
+    finally:
+        tr.close()
+
+
+def test_peek_topology_all_torn_sidecars_raise(tmp_path):
+    """Satellite bugfix: an aux dir whose sidecars are ALL torn must
+    raise an actionable error naming the dir and the newest attempted
+    step — a silent None would read downstream as 'pre-elastic
+    checkpoint, nothing to reconcile' and bypass the must-abort
+    classification."""
+    from p2p_tpu.train.checkpoint import SidecarCorrupt, peek_topology
+
+    d = str(tmp_path / "ck")
+    aux = d + ".aux"
+    os.makedirs(aux)
+    for s in (3, 7):
+        with open(os.path.join(aux, f"{s}.json"), "w") as f:
+            f.write('{"step": %d, "topol' % s)  # torn half-writes
+    with pytest.raises(SidecarCorrupt) as ei:
+        peek_topology(d)
+    msg = str(ei.value)
+    assert d in msg and "7" in msg  # names the dir + newest step
+    assert ei.value.newest_step == 7
+    # one VALID pre-elastic sidecar flips it back to a legitimate None
+    with open(os.path.join(aux, "2.json"), "w") as f:
+        json.dump({"step": 2}, f)
+    assert peek_topology(d) is None
+
+
+def _int8_cfg(data_axis: int, model_axis: int = 1,
+              recalibrate_steps: int = 0):
+    import dataclasses
+
+    cfg = _elastic_cfg(data_axis)
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, int8=True, int8_generator=True,
+                                  int8_delayed=True),
+        parallel=dataclasses.replace(
+            cfg.parallel, mesh=MeshSpec(data=data_axis, model=model_axis)),
+        train=dataclasses.replace(cfg.train,
+                                  recalibrate_steps=recalibrate_steps),
+    )
+
+
+def test_tp_width_migration_under_int8_recalibrates(tmp_path, monkeypatch):
+    """TP-width change under delayed-int8 amax state is a MIGRATION: the
+    stored scales remap by the closed-form law (identity for the repo's
+    per-tensor scalars — pinned bitwise against a same-topology control)
+    and --recalibrate_steps holds them frozen for the warmup window."""
+    from p2p_tpu.data.synthetic import make_synthetic_dataset
+    from p2p_tpu.resilience import Preempted
+    from p2p_tpu.train.loop import Trainer
+
+    monkeypatch.setenv("P2P_TPU_NO_GRAIN", "1")
+    root = make_synthetic_dataset(str(tmp_path / "data"), 8, 2, size=16)
+    wd = str(tmp_path / "w")
+    tr = Trainer(_int8_cfg(2), data_root=root, workdir=wd)
+    tr.preempt = _StopAfter(3)
+    try:
+        with pytest.raises(Preempted):
+            tr.fit()
+        assert jax.tree_util.tree_leaves(tr.state.quant_g)
+    finally:
+        tr.close()
+
+    # same-topology control: the quant scales the checkpoint holds
+    trc = Trainer(_int8_cfg(2), data_root=root, workdir=wd)
+    try:
+        assert trc.maybe_resume()
+        quant_c = jax.device_get((trc.state.quant_g, trc.state.quant_d))
+    finally:
+        trc.close()
+
+    # relaunch at TP width 2 (model axis 1 -> 2) with a 1-dispatch warmup
+    trb = Trainer(_int8_cfg(1, model_axis=2, recalibrate_steps=1),
+                  data_root=root, workdir=wd)
+    try:
+        assert trb.maybe_resume()
+        assert trb._quant_freeze_remaining == 1
+        quant_b = jax.device_get((trb.state.quant_g, trb.state.quant_d))
+        for a, b in zip(jax.tree_util.tree_leaves(quant_c),
+                        jax.tree_util.tree_leaves(quant_b)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                "per-tensor amax must be TP-width invariant"
+        trb.fit()
+        assert trb._quant_freeze_remaining == 0
+    finally:
+        trb.close()
+
+    recs = _records(os.path.join(wd, "metrics_elastic.jsonl"))
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[-1]["decision"] == "migrate"
+    assert el[-1]["chain"] == ["tp_amax_recalibrate"]
+    rc = [r for r in recs if r.get("kind") == "tp_amax_recalibrate"]
+    assert rc and rc[0]["width_saved"] == 1 and rc[0]["width_current"] == 2
+    assert [r for r in recs if r.get("kind") == "recalibrate_done"]
+
+
+def test_dtype_migration_casts_with_opt_in(_preempted_run):
+    """A moment-dtype change aborts by default; with --cast_on_restore it
+    becomes an explicit, logged cast — moments land in the new storage
+    dtype per the policy table, and the integrity manifest is
+    REGENERATED so the next restore's CRC verification is meaningful
+    (instead of silently skipping every dtype-changed leaf)."""
+    import dataclasses
+
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+    cfg = _elastic_cfg(2)
+    cfg = dataclasses.replace(
+        cfg, optim=dataclasses.replace(cfg.optim, moment_dtype="bfloat16"))
+
+    tr = Trainer(cfg, data_root=root, workdir=wd)
+    try:
+        with pytest.raises(TopologyMismatch, match="--cast_on_restore"):
+            tr.maybe_resume()
+    finally:
+        tr.close()
+
+    cfg2 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, cast_on_restore=True))
+    tr2 = Trainer(cfg2, data_root=root, workdir=wd)
+    try:
+        assert tr2.maybe_resume()
+        import jax.numpy as jnp
+
+        mu_leaf = jax.tree_util.tree_leaves(
+            tr2.state.opt_g.inner_state[0].mu)[0]
+        assert mu_leaf.dtype == jnp.bfloat16
+        # the manifest now names the POST-cast state...
+        man = tr2.ckpt.integrity_manifest(3)
+        assert man and man.get("migrated")
+        recs = _records(os.path.join(wd, "metrics_elastic.jsonl"))
+        dm = [r for r in recs if r.get("kind") == "dtype_migration"]
+        assert dm and dm[0]["moment_policy"] == "cast"
+        assert dm[0]["cast_leaves"] > 0
+        el = [r for r in recs if r.get("kind") == "elastic_resume"]
+        assert el and el[-1]["decision"] == "migrate"
+        assert el[-1]["chain"] == ["dtype_cast"]
+        # ...so a SECOND restore with the same template verifies CRCs
+        # cleanly (deterministic cast → identical post-cast bytes)
+        restored = tr2.ckpt.restore(tr2.state, step=3, fallback=False)
+        assert tr2.obs.counter("ckpt_corrupt_total").value == 0
+        del restored
+    finally:
+        tr2.close()
+
+
+def test_missing_sample_fields_degrade_with_counter(_preempted_run):
+    """Sidecar forward-compat satellite: a pre-PR-11 sidecar (no
+    samples_seen/epoch_samples_done) degrades to the step×batch
+    derivation — counted on aux_compat_total, never an exception — and
+    the batch-rebase migration still lands on the exact position (the
+    fallback is exact whenever the dead run never changed batch)."""
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+    p = _aux_path(wd, 3)
+    with open(p) as f:
+        aux = json.load(f)
+    assert aux.pop("samples_seen") == 12   # the new field IS written
+    aux.pop("epoch_samples_done")
+    with open(p, "w") as f:
+        json.dump(aux, f)
+
+    tr = Trainer(_elastic_cfg(2, batch=2), data_root=root, workdir=wd)
+    try:
+        assert tr.maybe_resume()
+        assert tr.obs.counter("aux_compat_total").value == 1
+        assert tr._samples_seen == 12
+        assert tr._resume_skip_samples == 4
+        assert int(np.asarray(jax.device_get(tr.state.step))) == 6
     finally:
         tr.close()
 

@@ -380,6 +380,72 @@ def test_int8_multiscale_d_frozen_scale_eval_bitwise():
     assert jax.tree_util.tree_leaves(mut["quant"])
 
 
+def test_reshard_amax_law_pins():
+    """The elastic TP-width amax resharding law (ops/int8.reshard_amax,
+    driven by the ``tp_amax_recalibrate`` migration): per-tensor scalars
+    are width-invariant; a per-shard [W] amax broadcasts on widen and
+    max-reduces on narrow; widen-then-narrow round-trips BITWISE."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.ops.int8 import reshard_amax
+
+    # per-tensor (scalar) amax — the repo's amax_x form: identity at any
+    # width pair (the stored jnp.max is a GLOBAL reduction under GSPMD)
+    s = jnp.float32(3.75)
+    for w_old, w_new in ((1, 2), (4, 2), (2, 8)):
+        np.testing.assert_array_equal(
+            np.asarray(reshard_amax(s, w_old, w_new)), np.asarray(s))
+
+    # per-shard vector: widen 2 -> 4 broadcasts each shard to its children
+    a2 = jnp.asarray([1.5, 7.25], jnp.float32)
+    a4 = reshard_amax(a2, 2, 4)
+    np.testing.assert_array_equal(
+        np.asarray(a4), np.asarray([1.5, 1.5, 7.25, 7.25], np.float32))
+    # ...then narrow 4 -> 2 max-reduces — the widen-then-narrow
+    # round-trip reproduces the original per-shard scales bitwise
+    np.testing.assert_array_equal(
+        np.asarray(reshard_amax(a4, 4, 2)), np.asarray(a2))
+    # narrow is an exact max of maxes
+    a_uneven = jnp.asarray([2.0, 9.0, 4.0, 3.0], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(reshard_amax(a_uneven, 4, 2)),
+        np.asarray([9.0, 4.0], np.float32))
+    # indivisible widths fail loudly
+    with pytest.raises(ValueError, match="divide"):
+        reshard_amax(jnp.zeros((3,)), 3, 2)
+    with pytest.raises(ValueError, match="divide"):
+        reshard_amax(jnp.zeros((2,)), 2, 3)
+
+
+def test_frozen_scale_eval_unchanged_by_amax_migration():
+    """The TP-migration parity pin: the repo's stored scales are
+    per-tensor (global-reduction amax), so the closed-form width remap is
+    the identity on them — a frozen-scale eval AFTER a TP-width migration
+    is BITWISE the pre-migration eval (strictly inside the existing
+    frozen-scale parity band)."""
+    import jax.numpy as jnp
+
+    from p2p_tpu.models.registry import define_D
+    from p2p_tpu.ops.int8 import reshard_amax
+
+    cfg = _multi_d_cfg()
+    d = define_D(cfg.model)
+    rng = np.random.default_rng(5)
+    pair = jnp.asarray(rng.uniform(-1, 1, (2, 32, 32, 6)), jnp.float32)
+    v = d.init(jax.random.key(1), pair)
+    migrated = jax.tree_util.tree_map(
+        lambda a: reshard_amax(a, 2, 4), v["quant"])
+    for a, b in zip(jax.tree_util.tree_leaves(v["quant"]),
+                    jax.tree_util.tree_leaves(migrated)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    base = {"params": v["params"], "spectral": v["spectral"]}
+    out_before = d.apply({**base, "quant": v["quant"]}, pair)
+    out_after = d.apply({**base, "quant": migrated}, pair)
+    for a, b in zip(jax.tree_util.tree_leaves(out_before),
+                    jax.tree_util.tree_leaves(out_after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 @pytest.mark.slow
 def test_int8_multiscale_d_lsgan_stability_band():
     """The LSGAN-stability parity band, D-side twin of the G-trunk one:

@@ -247,51 +247,16 @@ def _all_train_steps(wd, name):
     """Union of per-step records across every process's metrics file
     (proc 0 writes metrics_<name>.jsonl, proc N a metrics_<name>.pN.jsonl
     sibling — train/loop.py metrics_path)."""
-    out, seen = [], []
-    for fn in sorted(os.listdir(wd)):
-        if fn == f"metrics_{name}.jsonl" or (
-                fn.startswith(f"metrics_{name}.p")
-                and fn.endswith(".jsonl")):
-            seen.append(fn)
-            out.extend(_train_steps(os.path.join(wd, fn)))
-    assert len(seen) >= 1, f"no metrics files for {name} in {wd}"
-    return out
+    return _all_train_records(wd, name, "step")
 
 
-@pytest.mark.slow
-def test_elastic_kill_resume_across_process_count_and_mesh(tmp_path):
-    """THE elastic acceptance pin, end-to-end over real processes: a
-    2-process (4-device, data=4) CLI run is preempted mid-epoch by the
-    ``elastic`` chaos seam (deterministic synthetic SIGTERM at host step
-    3, cross-host agreed) and exits 75 on both processes; the relaunch is
-    SINGLE-process on a data=2 mesh — a different process count, device
-    count, and data-axis width — against the same workdir. It must
-    reconcile the sidecar's recorded topology, reshard the restore, and
-    finish with GAPLESS per-sample accounting: the union of both phases'
-    per-step records is exactly 1..steps_per_epoch, nothing replayed,
-    nothing skipped."""
+def _gloo_phase_a(tmp_path, wd, args, repo, extra_mesh, n_expect_steps=3):
+    """Launch the 2-process gloo phase A (elastic@3 preemption), wait for
+    both exits, assert rc=75 everywhere; returns the env used."""
     import socket
 
     from p2p_tpu.resilience import PREEMPTED_EXIT_CODE
 
-    n_train = 24          # bs 4 → 6 steps/epoch; kill at step 3
-    root = make_synthetic_dataset(str(tmp_path / "data"), n_train, 2, size=16)
-    wd = str(tmp_path / "w")
-    os.makedirs(wd)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-    args = [
-        "--preset", "facades", "--data_root", root, "--workdir", wd,
-        "--name", "el", "--dataset", "elsynth",
-        "--image_size", "16", "--batch_size", "4", "--test_batch_size", "2",
-        "--ngf", "4", "--ndf", "4", "--threads", "0",
-        "--nepoch", "1", "--niter", "1", "--niter_decay", "0",
-        "--epochsave", "1", "--seed", "0", "--lambda_vgg", "0",
-        "--log_every", "1",
-    ]
-
-    # ---- phase A: 2 processes x 2 local devices = data=4 mesh, killed
-    # mid-epoch at host step 3 by the elastic chaos seam
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -310,17 +275,210 @@ def test_elastic_kill_resume_across_process_count_and_mesh(tmp_path):
         lf = open(log_path, "w")
         procs.append(subprocess.Popen(
             [sys.executable, worker, str(pid), "2", str(port),
-             *args, "--mesh=-1,1,1"],
+             *args, extra_mesh],
             env=env, stdout=lf, stderr=subprocess.STDOUT, cwd=repo,
         ))
     rcs = [p.wait(timeout=540) for p in procs]
     for pid, rc in enumerate(rcs):
         if rc != PREEMPTED_EXIT_CODE:
-            with open(logs[pid]) as f:
-                pytest.fail(f"phase-A worker {pid} exited {rc} "
-                            f"(want 75):\n{f.read()[-4000:]}")
+            texts = []
+            for lg in logs:
+                with open(lg) as f:
+                    texts.append(f.read())
+            _skip_if_gloo_transport_broken(texts, wd)
+            pytest.fail(f"phase-A worker {pid} exited {rc} "
+                        f"(want 75):\n{texts[pid][-4000:]}")
     with open(logs[0]) as f:
         assert "preempted: checkpoint saved at step 3" in f.read()
+    return env
+
+
+def _skip_if_gloo_transport_broken(log_texts, wd):
+    """Some hosts (observed: 1-vCPU CI boxes) cannot form the 2-process
+    gloo CPU cluster at all — a worker dies inside the gloo TCP transport
+    (EnforceNotMet size-mismatch / all-reduce read error) during plain
+    trainer CONSTRUCTION, before any training or chaos fires. That is an
+    environment limitation, not a regression in the elastic path — skip
+    with the evidence named instead of failing the rehearsal.
+
+    Anchored to "no training ever happened" (zero kind=train records in
+    the shared workdir): a gloo error AFTER steps ran could be a real
+    collective-divergence regression mid-rehearsal and must FAIL, not
+    skip."""
+    markers = ("gloo::EnforceNotMet", "Gloo all-reduce failed")
+    hit = next((m for m in markers for t in log_texts if m in t), None)
+    if hit is None:
+        return
+    trained = any(
+        _train_steps(os.path.join(wd, fn))
+        for fn in sorted(os.listdir(wd))
+        if fn.startswith("metrics_") and fn.endswith(".jsonl"))
+    if not trained:
+        pytest.skip(
+            f"gloo CPU collectives transport is broken on this host "
+            f"({hit} during cluster formation, zero train steps logged) "
+            "— the 2-process rehearsal cannot form; run on a multi-core "
+            "host / CI for the real pin")
+
+
+def _all_train_records(wd, name, key):
+    """Values of ``key`` across every process's kind=train records."""
+    out, seen = [], []
+    for fn in sorted(os.listdir(wd)):
+        if fn == f"metrics_{name}.jsonl" or (
+                fn.startswith(f"metrics_{name}.p")
+                and fn.endswith(".jsonl")):
+            seen.append(fn)
+            with open(os.path.join(wd, fn)) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("kind") == "train" and key in rec:
+                        out.append(int(rec[key]))
+    assert seen, f"no metrics files for {name} in {wd}"
+    return out
+
+
+@pytest.mark.slow
+def test_elastic_kill_resume_batch_change_gapless_samples(tmp_path):
+    """PR-11 chaos rehearsal, cross-BATCH: a 2-process bs=4 run killed at
+    step 3 (12 samples consumed) relaunches single-process at bs=2. The
+    relaunch must classify ``migrate`` (batch_rebase), re-base the step
+    counter to the sample basis, finish rc=0, and the per-process
+    SAMPLE-record union must tile the epoch exactly — old-batch prefix
+    {4,8,12} ∪ new-batch suffix {14,...,24}, no gap, no overlap."""
+    n_train = 24          # bs 4 → 6 steps/epoch; kill at step 3
+    root = make_synthetic_dataset(str(tmp_path / "data"), n_train, 2, size=16)
+    wd = str(tmp_path / "w")
+    os.makedirs(wd)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [
+        "--preset", "facades", "--data_root", root, "--workdir", wd,
+        "--name", "eb", "--dataset", "ebsynth",
+        "--image_size", "16", "--batch_size", "4", "--test_batch_size", "2",
+        "--ngf", "4", "--ndf", "4", "--threads", "0",
+        "--nepoch", "1", "--niter", "1", "--niter_decay", "0",
+        "--epochsave", "1", "--seed", "0", "--lambda_vgg", "0",
+        "--log_every", "1",
+    ]
+    env = _gloo_phase_a(tmp_path, wd, args, repo, "--mesh=-1,1,1")
+    samples_a = sorted(set(_all_train_records(wd, "eb", "samples")))
+    assert samples_a == [4, 8, 12]
+
+    # phase B: single process, data=2 mesh, HALF the global batch
+    env_b = dict(env)
+    env_b.pop("P2P_CHAOS", None)
+    out2 = subprocess.run(
+        [sys.executable, "-c", _SHIM, *args,
+         "--mesh", "2,1,1", "--batch_size", "2"],
+        env=env_b, capture_output=True, text=True, timeout=540, cwd=repo,
+    )
+    assert out2.returncode == 0, out2.stdout[-3000:] + out2.stderr[-2000:]
+    assert "elastic resume" in out2.stdout
+    assert "batch re-base" in out2.stdout
+
+    recs = [json.loads(line)
+            for line in open(os.path.join(wd, "metrics_eb.jsonl"))]
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[0]["decision"] == "migrate"
+    assert "batch_rebase" in el[0]["chain"]
+    rb = [r for r in recs if r.get("kind") == "batch_rebase"]
+    # 12 samples / new bs 2 → rebased step 6 on the 12-step epoch grid
+    assert rb and rb[0]["rebased_step"] == 6
+    assert rb[0]["samples_seen"] == 12
+
+    # THE pin: gapless per-SAMPLE accounting across the batch change —
+    # phase A consumed flat samples (0,12] in strides of 4, phase B must
+    # consume exactly (12,24] in strides of 2
+    samples = sorted(set(_all_train_records(wd, "eb", "samples")))
+    assert samples == [4, 8, 12] + list(range(14, 25, 2)), samples
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    assert len(epochs) == 1 and int(epochs[0]["epoch"]) == 1
+
+
+@pytest.mark.slow
+def test_elastic_kill_resume_pipe_width_change(tmp_path):
+    """PR-11 chaos rehearsal, cross-PIPE-WIDTH: a 2-process pipe=2 run
+    killed mid-epoch relaunches single-process at pipe=1 (plus a
+    data-axis change). The relaunch must classify ``migrate``
+    (pp_restructure), finish rc=0, and the per-process step-record union
+    stays gapless 1..steps_per_epoch."""
+    n_train = 24
+    root = make_synthetic_dataset(str(tmp_path / "data"), n_train, 2, size=16)
+    wd = str(tmp_path / "w")
+    os.makedirs(wd)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [
+        "--preset", "facades", "--data_root", root, "--workdir", wd,
+        "--name", "ep", "--dataset", "epsynth",
+        "--image_size", "16", "--batch_size", "4", "--test_batch_size", "2",
+        "--ngf", "4", "--ndf", "4", "--threads", "0",
+        "--nepoch", "1", "--niter", "1", "--niter_decay", "0",
+        "--epochsave", "1", "--seed", "0", "--lambda_vgg", "0",
+        "--log_every", "1",
+    ]
+    # data=-1 resolves to 2 across the 2x2-device cluster, pipe=2
+    env = _gloo_phase_a(tmp_path, wd, args, repo, "--mesh=-1,1,1,1,2")
+    ckpt_dir = os.path.join(wd, "checkpoint", "epsynth", "ep")
+    with open(os.path.join(ckpt_dir + ".aux", "3.json")) as f:
+        topo = json.load(f)["topology"]
+    assert topo["mesh"]["pipe"] == 2
+
+    env_b = dict(env)
+    env_b.pop("P2P_CHAOS", None)
+    out2 = subprocess.run(
+        [sys.executable, "-c", _SHIM, *args, "--mesh", "2,1,1"],
+        env=env_b, capture_output=True, text=True, timeout=540, cwd=repo,
+    )
+    assert out2.returncode == 0, out2.stdout[-3000:] + out2.stderr[-2000:]
+    assert "elastic resume" in out2.stdout
+
+    recs = [json.loads(line)
+            for line in open(os.path.join(wd, "metrics_ep.jsonl"))]
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[0]["decision"] == "migrate"
+    assert "pp_restructure" in el[0]["chain"]
+    rs = [r for r in recs if r.get("kind") == "resharded_restore"]
+    assert rs and rs[0]["resharded_restore_total"] >= 1
+    steps = sorted(set(_all_train_steps(wd, "ep")))
+    spe = n_train // 4
+    assert steps == list(range(1, spe + 1)), (
+        f"step gaps/repeats across the pipe-width relaunch: {steps}")
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    assert len(epochs) == 1 and int(epochs[0]["epoch"]) == 1
+
+
+@pytest.mark.slow
+def test_elastic_kill_resume_across_process_count_and_mesh(tmp_path):
+    """THE elastic acceptance pin, end-to-end over real processes: a
+    2-process (4-device, data=4) CLI run is preempted mid-epoch by the
+    ``elastic`` chaos seam (deterministic synthetic SIGTERM at host step
+    3, cross-host agreed) and exits 75 on both processes; the relaunch is
+    SINGLE-process on a data=2 mesh — a different process count, device
+    count, and data-axis width — against the same workdir. It must
+    reconcile the sidecar's recorded topology, reshard the restore, and
+    finish with GAPLESS per-sample accounting: the union of both phases'
+    per-step records is exactly 1..steps_per_epoch, nothing replayed,
+    nothing skipped."""
+    n_train = 24          # bs 4 → 6 steps/epoch; kill at step 3
+    root = make_synthetic_dataset(str(tmp_path / "data"), n_train, 2, size=16)
+    wd = str(tmp_path / "w")
+    os.makedirs(wd)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    args = [
+        "--preset", "facades", "--data_root", root, "--workdir", wd,
+        "--name", "el", "--dataset", "elsynth",
+        "--image_size", "16", "--batch_size", "4", "--test_batch_size", "2",
+        "--ngf", "4", "--ndf", "4", "--threads", "0",
+        "--nepoch", "1", "--niter", "1", "--niter_decay", "0",
+        "--epochsave", "1", "--seed", "0", "--lambda_vgg", "0",
+        "--log_every", "1",
+    ]
+
+    # ---- phase A: 2 processes x 2 local devices = data=4 mesh, killed
+    # mid-epoch at host step 3 by the elastic chaos seam (shared helper;
+    # skips on hosts whose gloo transport cannot form the cluster)
+    env = _gloo_phase_a(tmp_path, wd, args, repo, "--mesh=-1,1,1")
 
     ckpt_dir = os.path.join(wd, "checkpoint", "elsynth", "el")
     assert os.path.isdir(os.path.join(ckpt_dir, "3"))

@@ -498,6 +498,95 @@ def test_pp_split_state_moves_trunk_to_stages(devices8):
     assert state.pp_stages is None and state.opt_s is None
 
 
+def _fill_opt_moments(opt):
+    """Distinctive values in every float leaf (moments) so preservation —
+    not re-initialization — is what the round-trip pins observe."""
+    leaves, treedef = jax.tree_util.tree_flatten(opt)
+    filled = [
+        jnp.full_like(leaf, (i % 7) + 1.25)
+        if jnp.issubdtype(leaf.dtype, jnp.floating) else leaf
+        for i, leaf in enumerate(leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, filled)
+
+
+def _assert_trees_bitwise(a, b, what):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, f"{what}: tree structure differs"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"{what}: leaf {i} differs")
+
+
+def test_pp_merge_state_inverts_split_bitwise():
+    """The elastic pipe-width migration law: pp_merge_state is the exact
+    inverse of pp_split_state(init_opt=False) — params, batch stats, AND
+    live optimizer moments round-trip bitwise through ANY width chain
+    (flat → 2 stages → flat → 4 stages → flat), so a mid-run checkpoint
+    re-expresses at a new pipe width without losing its trajectory."""
+    from p2p_tpu.parallel.pp import pp_merge_state, pp_split_state
+    from p2p_tpu.train.state import create_train_state
+
+    cfg = _pp_gan_cfg()  # 4 trunk blocks
+    rng = np.random.default_rng(3)
+    batch = {k: jnp.asarray(rng.uniform(-1, 1, (4, 32, 32, 3)), jnp.float32)
+             for k in ("input", "target")}
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    state = state.replace(opt_g=_fill_opt_moments(state.opt_g))
+
+    split2 = pp_split_state(state, cfg, mesh=None, n_stages=2,
+                            init_opt=False, place=False)
+    # the stacked moments carry the LIVE values (not re-init zeros):
+    # stage-stacked leaf [s, j] == block s*B+j's flat moment
+    mu_stack = jax.tree_util.tree_leaves(split2.opt_s)[1]  # a mu leaf
+    assert float(jnp.max(jnp.abs(mu_stack))) > 0
+    merged = pp_merge_state(split2, cfg)
+    _assert_trees_bitwise(merged, state, "merge(split2)")
+
+    # widen the chain: flat -> 4 stages -> flat
+    split4 = pp_split_state(merged, cfg, mesh=None, n_stages=4,
+                            init_opt=False, place=False)
+    k4 = split4.pp_stages["params"]["ConvLayer_0"]["Conv_0"]["kernel"]
+    assert k4.shape[:2] == (4, 1)
+    _assert_trees_bitwise(pp_merge_state(split4, cfg), state,
+                          "merge(split4)")
+
+
+def test_pp_split_preserved_moments_match_blocks():
+    """init_opt=False stacks the trunk's flat Adam moments under the same
+    [S, B] ordering law as the params — block s·B+j at [s, j]."""
+    from p2p_tpu.parallel.pp import pp_split_state
+    from p2p_tpu.train.state import create_train_state
+
+    cfg = _pp_gan_cfg()
+    rng = np.random.default_rng(4)
+    batch = {k: jnp.asarray(rng.uniform(-1, 1, (4, 32, 32, 3)), jnp.float32)
+             for k in ("input", "target")}
+    state = create_train_state(cfg, jax.random.key(0), batch)
+    # per-block distinctive moments: mu[block_i] = i + 1 everywhere
+    mu = state.opt_g.inner_state[0].mu
+    mu = {k: (jax.tree_util.tree_map(
+        lambda a, i=int(k.rsplit("_", 1)[1]): jnp.full_like(a, i + 1.0), v)
+        if k.startswith("ResidualBlock_") else v) for k, v in mu.items()}
+    adam = state.opt_g.inner_state[0]._replace(mu=mu)
+    state = state.replace(opt_g=state.opt_g._replace(
+        inner_state=(adam,) + tuple(state.opt_g.inner_state[1:])))
+
+    split = pp_split_state(state, cfg, mesh=None, n_stages=2,
+                           init_opt=False, place=False)
+    mu_s = split.opt_s.inner_state[0].mu
+    k = np.asarray(mu_s["ConvLayer_0"]["Conv_0"]["kernel"])
+    assert k.shape[:2] == (2, 2)
+    for s in range(2):
+        for j in range(2):
+            i = s * 2 + j
+            assert np.all(k[s, j] == i + 1.0), (s, j)
+    # counts/hyperparams ride through on both sides
+    assert int(split.opt_s.count) == int(state.opt_g.count)
+    assert int(split.opt_g.count) == int(state.opt_g.count)
+
+
 @pytest.mark.slow
 def test_pp_full_gan_step_matches_unpipelined(devices8):
     """The tentpole pin: build_pp_train_step — the COMPLETE alternating

@@ -615,6 +615,7 @@ def test_preempt_writes_sidecar_and_metrics_record(tmp_path, monkeypatch):
     assert topo["process_count"] == 1 and topo["global_batch"] == 2
     assert aux == {"step": 3, "epoch": 1, "batches_done": 3,
                    "steps_per_epoch": 4, "aug_seed": 1,
+                   "samples_seen": 6, "epoch_samples_done": 6,
                    "seed_jitter": 0, "lr_base": 1.0}
     kinds = [json.loads(line) for line in
              open(os.path.join(wd, "metrics_exact.jsonl"))]
