@@ -24,9 +24,10 @@ Env knobs: BENCH_PRESET, BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG;
 BENCH_JSONL=<path> additionally appends the record (kind="bench") to that
 metrics stream through the obs registry.
 
-``--sweep`` runs the eight BASELINE.md contract rows (headline, bs=1,
+``--sweep`` runs the nine BASELINE.md contract rows (headline, bs=1,
 edges2shoes int8-delayed, cityscapes, pix2pixhd, vid2vid, the round-6
-int8-multiscale-D and pallas-fusion rows) and diffs each against the
+int8-multiscale-D and pallas-fusion rows, and the round-7 open-loop
+serving row) and diffs each against the
 last-recorded band, exiting nonzero on a >3% regression below the band
 floor — the standing perf-regression gate (VERDICT r5 #7). New rows carry
 ``band: None`` until their first on-TPU recording lands in BASELINE.md.
@@ -555,6 +556,164 @@ def run_infer(tiny: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --serve: the open-loop serving-latency row (docs/SERVING.md "HTTP API")
+# ---------------------------------------------------------------------------
+
+def run_serve(tiny: bool = False) -> dict:
+    """Open-loop serving latency: synthetic clients submit requests on a
+    FIXED arrival schedule (independent of completions — the open-loop
+    discipline that exposes queueing delay closed-loop benchmarks hide)
+    against the continuous batcher + shared dispatch loop + AOT bucket
+    engine (p2p_tpu.serve.batcher/frontend — the exact serving stack
+    behind the HTTP frontend, minus the socket so the row measures
+    batching + inference, not urllib). Reports p50/p99 request latency
+    (admission → response bytes ready), served img/sec, and the bucket
+    occupancy the continuous batcher achieved — plus the standing
+    compile contract (n_compiles == len(buckets), zero mid-serve).
+
+    Env knobs: BENCH_PRESET (default facades_int8), BENCH_BS (largest
+    bucket / group cap), BENCH_IMG, BENCH_SERVE_N (total requests),
+    BENCH_SERVE_RATE (arrivals/sec; 0 = as-fast-as-possible burst),
+    BENCH_INFER_DTYPE (bf16|f32).
+    """
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.data.synthetic import synthetic_batch
+    from p2p_tpu.obs import MetricsRegistry
+    from p2p_tpu.resilience.queue import BoundedRequestQueue
+    from p2p_tpu.serve import (
+        ContinuousBatcher,
+        DispatchLoop,
+        InferenceEngine,
+        default_buckets,
+    )
+    from p2p_tpu.train.state import create_infer_state
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    preset = os.environ.get("BENCH_PRESET", "facades_int8")
+    cfg = get_preset(preset)
+    if tiny:
+        img, bs, n_req, rate = 32, 4, 24, 0.0
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, ngf=8, ndf=8, num_D=min(cfg.model.num_D, 2),
+            n_layers_D=2, n_blocks=min(cfg.model.n_blocks, 2)))
+    else:
+        img = int(os.environ.get("BENCH_IMG", "256" if on_tpu else "64"))
+        bs = int(os.environ.get("BENCH_BS", "64" if on_tpu else "4"))
+        n_req = int(os.environ.get("BENCH_SERVE_N",
+                                   "1024" if on_tpu else "64"))
+        rate = float(os.environ.get("BENCH_SERVE_RATE", "0"))
+    dtype = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, test_batch_size=bs, image_size=img, image_width=None))
+    buckets = default_buckets(bs)
+    u8 = cfg.data.uint8_pipeline
+    host = synthetic_batch(batch_size=1, size=img,
+                           bits=cfg.model.quant_bits,
+                           dtype="uint8" if u8 else "float32")
+    state = create_infer_state(cfg, jax.random.key(0), host)
+    engine = InferenceEngine(cfg, state, buckets=buckets, dtype=dtype,
+                             with_metrics=False)
+    engine.warmup()
+
+    reg = MetricsRegistry()
+    queue = BoundedRequestQueue(max_depth=max(4 * bs, n_req),
+                                registry=reg, tenant="bench")
+    batcher = ContinuousBatcher(queue, buckets, group_cap=bs,
+                                linger_s=0.002)
+    payload = host["input"][0]
+    latencies = []
+    done = threading.Event()
+
+    def deliver(reqs, pred, n_real):
+        # the response isn't served until the bytes are host-side: one
+        # batch D2H here makes the latency honest, like the HTTP
+        # responder's fetch (PNG encode excluded — that's --infer's
+        # encode_sec story)
+        np.asarray(pred)
+        now = time.monotonic()
+        for r in reqs:
+            latencies.append(now - r.enqueued_at)
+        if len(latencies) >= n_req:
+            done.set()
+
+    loop = DispatchLoop(
+        engine, batcher, decode=lambda req: req.payload, deliver=deliver,
+        on_poison=lambda req, exc: None, registry=reg, tenant="bench",
+        group_cap=bs)
+
+    consumer_exc = []
+
+    def consume():
+        try:
+            while not done.is_set():
+                ready, _ = batcher.next_group(timeout=0.05)
+                if ready:
+                    loop.dispatch(ready)
+        except BaseException as e:  # surface, don't stall done.wait(600)
+            consumer_exc.append(e)
+            done.set()
+
+    consumer = threading.Thread(target=consume, name="bench-serve",
+                                daemon=True)
+    consumer.start()
+    t0 = time.monotonic()
+    for i in range(n_req):
+        if rate > 0:
+            target = t0 + i / rate
+            while True:
+                lag = target - time.monotonic()
+                if lag <= 0:
+                    break
+                time.sleep(min(lag, 0.002))
+        while batcher.submit(f"r{i}", payload=payload) is None:
+            time.sleep(0.001)  # queue sized for n_req; near-unreachable
+    if not done.wait(600):
+        raise RuntimeError(
+            f"serve bench stalled: {len(latencies)}/{n_req} completed")
+    wall = max(time.monotonic() - t0, 1e-9)
+    batcher.close()
+    consumer.join(timeout=5.0)
+    if consumer_exc:
+        raise consumer_exc[0]
+
+    if engine.n_compiles != len(buckets):
+        raise RuntimeError(
+            f"bucket contract broken: {engine.n_compiles} compiles for "
+            f"{len(buckets)} buckets")
+    lat_ms = np.asarray(latencies) * 1e3
+    record = {
+        "metric": f"serve_openloop_{preset}_{dtype}_{platform}_"
+                  f"{img}px_bs{bs}",
+        "value": round(n_req / wall, 2),
+        "unit": "img/sec/chip",
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "n_requests": n_req,
+        "rate": rate,
+        "wall_sec": round(wall, 4),
+        "occupancy_mean": round(loop.occupancy_mean, 4),
+        "padded_images": loop.padded_images,
+        "n_compiles": engine.n_compiles,
+        "buckets": list(buckets),
+    }
+    if os.environ.get("BENCH_JSONL"):
+        from p2p_tpu.obs import JSONLSink
+
+        sink = JSONLSink(os.environ["BENCH_JSONL"])
+        reg.add_sink(sink)
+        reg.record({"kind": "bench_serve", **record}, force=True)
+        sink.close()
+    return record
+
+
+# ---------------------------------------------------------------------------
 # --sweep: the standing perf-regression gate (VERDICT r5 #7)
 # ---------------------------------------------------------------------------
 
@@ -591,6 +750,11 @@ SWEEP_ROWS = [
      "env": {"BENCH_PRESET": "cityscapes_spatial",
              "BENCH_NORM": "pallas_instance"},
      "band": None},
+    # round-7 row (ISSUE 12): the open-loop serving-latency row — the
+    # continuous-batching stack behind the HTTP frontend (run_serve);
+    # value is served img/sec, the record carries p50/p99 request latency
+    {"name": "serve_openloop_continuous_batch", "env": {},
+     "mode": "serve", "band": None},
 ]
 
 REGRESSION_TOLERANCE = 0.03
@@ -620,8 +784,10 @@ def run_sweep(dry_run: bool = False) -> int:
     try:
         for row in SWEEP_ROWS:
             os.environ.update(row["env"])
+            runner = (run_serve if row.get("mode") == "serve"
+                      else run_single)
             try:
-                rec = run_single(tiny=dry_run)
+                rec = runner(tiny=dry_run)
             finally:
                 for k in row["env"]:
                     os.environ.pop(k, None)
@@ -640,6 +806,10 @@ def run_sweep(dry_run: bool = False) -> int:
             entry = {"row": row["name"], "value": rec["value"],
                      "band": list(band) if band is not None else None,
                      "status": status, "metric": rec["metric"]}
+            if "p50_ms" in rec:
+                # the serving row's latency tail rides the sweep record
+                entry["latency_ms"] = {"p50": rec["p50_ms"],
+                                       "p99": rec["p99_ms"]}
             if "phases" in rec:
                 # the per-net attribution breakdown rides every sweep row
                 # (ISSUE 6 satellite — see _phase_breakdown)
@@ -666,13 +836,18 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sweep", action="store_true",
-                    help="run all eight BASELINE.md contract rows and fail "
+                    help="run all nine BASELINE.md contract rows and fail "
                          "on >3% regression below the recorded band "
-                         "(band-less round-6 rows report without gating)")
+                         "(band-less rows report without gating)")
     ap.add_argument("--infer", action="store_true",
                     help="bench the serving engine instead of the train "
                          "step: AOT bucket-batched inference + pipelined "
                          "PNG output, fenced breakdown (docs/SERVING.md)")
+    ap.add_argument("--serve", action="store_true",
+                    help="bench the SERVING STACK open-loop: continuous "
+                         "batcher + dispatch loop + engine under a fixed "
+                         "arrival schedule; reports p50/p99 request "
+                         "latency + served img/sec (docs/SERVING.md)")
     ap.add_argument("--chaos", nargs="?", const="__default__",
                     default=None, metavar="SPEC",
                     help="arm fault injection for the run. With --infer "
@@ -697,6 +872,12 @@ def main(argv=None) -> int:
         monkey = ChaosMonkey.from_spec(spec)
         install_chaos(monkey)
         chaos_counts = monkey.counts
+    if args.serve:
+        rec = run_serve(tiny=args.dry_run)
+        if chaos_counts is not None:
+            rec["chaos_injected"] = chaos_counts()
+        print(json.dumps(rec))
+        return 0
     if args.infer:
         rec = run_infer(tiny=args.dry_run)
         if chaos_counts is not None:

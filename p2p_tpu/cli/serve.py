@@ -1,11 +1,27 @@
-"""Serving CLI — a micro-batching frontend over the inference engine.
+"""Serving CLI — directory-watching and HTTP frontends over the engine.
 
-``python -m p2p_tpu.cli.serve`` watches a directory of request images
-(raw files are the "RPC": drop an image in, get its translation out),
-groups arrivals into micro-batches (up to ``--max_batch``, lingering at
-most ``--linger_ms`` for stragglers), pads each group to an AOT-compiled
-bucket, and writes predictions named after their inputs. ``--once``
-processes the directory's current contents and exits — the CI smoke mode.
+Two transports, ONE hardened request lifecycle (p2p_tpu/serve/frontend.py
+— bounded queue, load shedding, deadlines, decode-retry with backoff,
+poison quarantine, bucket-occupancy accounting):
+
+**Directory mode** (default): ``python -m p2p_tpu.cli.serve`` watches a
+directory of request images (raw files are the "RPC": drop an image in,
+get its translation out), groups arrivals into micro-batches (up to
+``--max_batch``, lingering at most ``--linger_ms`` for stragglers), pads
+each group to an AOT-compiled bucket, and writes predictions named after
+their inputs. ``--once`` processes the directory's current contents and
+exits — the CI smoke mode.
+
+**HTTP mode** (``--http HOST:PORT``): the network-native frontend
+(p2p_tpu/serve/server.py) — ``POST /v1/{model}/translate`` with an image
+body returns the translated PNG; ``/healthz``; Prometheus ``/metrics``;
+``POST /admin/reload`` hot-swaps a tenant's weights with zero downtime.
+``--tenant`` (repeatable) makes N models resident in this one process,
+each with its own engine and bucket programs, sharing the persistent
+compilation cache; requests are batched CONTINUOUSLY across concurrent
+in-flight connections (serve/batcher.py). SIGTERM drains gracefully
+(stop accepting → run queues down → exit 0). Full API + runbook:
+docs/SERVING.md.
 
 Request semantics per preset family (same as eval — SURVEY Q10): with a
 compression net the request image is the TARGET (G runs from its
@@ -22,8 +38,10 @@ dropped at dispatch, not served late), decode failures retry with backoff
 up to ``--max_attempts`` and then the file is MOVED to a quarantine dir
 (``--quarantine_dir``, default ``<input_dir>/failed``) so one poison
 input can never wedge the server, and predictions are written atomically
-(temp + rename — serve/io.py). ``--chaos``/``P2P_CHAOS`` inject faults at
-the decode/write seams to rehearse all of the above.
+(temp + rename — serve/io.py). Over HTTP the same ladder answers in
+status codes: shed → 429, deadline → 504, poison → 422, draining → 503.
+``--chaos``/``P2P_CHAOS`` inject faults at the decode/write seams to
+rehearse all of the above.
 """
 
 from __future__ import annotations
@@ -34,7 +52,7 @@ import os
 import sys
 import time
 
-import numpy as np
+from p2p_tpu.serve.frontend import default_buckets  # noqa: F401 — re-export
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -46,9 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step to serve (default: latest)")
     p.add_argument("--workdir", type=str, default=".")
-    p.add_argument("--input_dir", type=str, required=True,
-                   help="request directory: image files dropped here are "
-                        "served in arrival order")
+    p.add_argument("--input_dir", type=str, default=None,
+                   help="directory mode's request directory: image files "
+                        "dropped here are served in arrival order "
+                        "(required unless --http)")
     p.add_argument("--out", type=str, default=None,
                    help="prediction dir (default <input_dir>_out)")
     p.add_argument("--image_size", type=int, default=None)
@@ -81,6 +100,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--io_threads", type=int, default=4)
     p.add_argument("--compilation_cache", type=str, default=None,
                    metavar="DIR")
+    # --- network frontend (docs/SERVING.md "HTTP API") -------------------
+    p.add_argument("--http", type=str, default=None, metavar="HOST:PORT",
+                   help="serve over HTTP instead of a watched directory "
+                        "(e.g. '0.0.0.0:8000'; ':0' binds an ephemeral "
+                        "port). POST /v1/<tenant>/translate, /healthz, "
+                        "/metrics, POST /admin/reload")
+    p.add_argument("--tenant", action="append", default=None,
+                   metavar="SPEC",
+                   help="HTTP mode: make a model resident, repeatable. "
+                        "SPEC is comma-separated key=value overriding the "
+                        "base flags, e.g. 'alias=hd,preset=pix2pixhd,"
+                        "name=run3,step=2000' (keys: alias preset name "
+                        "dataset step image_size ngf n_blocks ema_decay). "
+                        "Default: one tenant from the base flags")
+    p.add_argument("--drain_timeout", type=float, default=30.0,
+                   help="HTTP mode: max seconds after SIGTERM to run the "
+                        "queues down before stragglers are answered 503")
     # --- resilience knobs (docs/RESILIENCE.md) ---------------------------
     p.add_argument("--max_queue", type=int, default=512,
                    help="request queue depth cap; overflow arrivals are "
@@ -92,7 +128,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = no deadline)")
     p.add_argument("--max_attempts", type=int, default=3,
                    help="decode attempts per request before the file is "
-                        "moved to the quarantine dir")
+                        "moved to the quarantine dir (HTTP: before the "
+                        "request is answered 422)")
     p.add_argument("--retry_delay_ms", type=float, default=1000.0,
                    help="base delay between decode attempts (a file still "
                         "being copied in gets this grace window, with "
@@ -107,44 +144,161 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def default_buckets(max_batch: int):
-    """1, 2, 4, ... up to (and including) max_batch — a request group of
-    any size <= max_batch pads to at most 2× its images."""
-    b, out = 1, []
-    while b < max_batch:
-        out.append(b)
-        b *= 2
-    out.append(max_batch)
-    return tuple(sorted(set(out)))
+def _build_config(args, overrides=None):
+    """One tenant's Config from the base flags plus optional per-tenant
+    SPEC overrides ({key: str})."""
+    import dataclasses
+
+    from p2p_tpu.cli import apply_overrides as over
+    from p2p_tpu.core.config import get_preset
+
+    ov = dict(overrides or {})
+    preset = ov.get("preset", args.preset)
+    cfg = get_preset(preset)
+
+    def _get(key, cast, default):
+        if key in ov:
+            return cast(ov[key])
+        return default
+
+    data = over(cfg.data,
+                dataset=_get("dataset", str, args.dataset),
+                image_size=_get("image_size", int, args.image_size))
+    model = over(cfg.model, ngf=_get("ngf", int, args.ngf),
+                 n_blocks=_get("n_blocks", int, args.n_blocks))
+    health = over(cfg.health,
+                  ema_decay=_get("ema_decay", float, args.ema_decay))
+    name = _get("name", str, args.name) or cfg.name
+    return dataclasses.replace(cfg, data=data, model=model, health=health,
+                               name=name)
+
+
+def _parse_tenant_spec(spec: str):
+    """'alias=hd,preset=pix2pixhd,step=2000' → (alias, {key: value})."""
+    allowed = {"alias", "preset", "name", "dataset", "step", "image_size",
+               "ngf", "n_blocks", "ema_decay"}
+    kv = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, eq, v = part.partition("=")
+        if not eq or k not in allowed:
+            raise ValueError(
+                f"bad --tenant entry {part!r} (allowed keys: "
+                f"{sorted(allowed)})")
+        kv[k] = v
+    alias = kv.pop("alias", None) or kv.get("name") or kv.get("preset")
+    if not alias:
+        raise ValueError(f"--tenant {spec!r} needs an alias= (or name=/"
+                         "preset= to derive one)")
+    return alias, kv
+
+
+def _engine_kw(args, buckets):
+    from p2p_tpu.cli.infer import _parse_mesh
+
+    return dict(
+        buckets=buckets, dtype=args.dtype, mesh=_parse_mesh(args.mesh),
+        tp_min_ch=args.tp_min_ch, with_metrics=False,
+        compilation_cache_dir=args.compilation_cache,
+        io_workers=args.io_threads,
+    )
+
+
+def _serve_http(args, buckets) -> int:
+    """The network frontend: N resident tenants, continuous batching,
+    hot-swap, graceful drain (p2p_tpu/serve/server.py)."""
+    from p2p_tpu.obs import get_registry
+    from p2p_tpu.resilience import ChaosMonkey, install_chaos
+    from p2p_tpu.serve.server import ServeApp, run_server
+    from p2p_tpu.serve.tenancy import Tenant, checkpoint_dir
+
+    host, _, port = args.http.rpartition(":")
+    host = host or "0.0.0.0"
+    try:
+        port = int(port)
+    except ValueError:
+        print(f"--http wants HOST:PORT, got {args.http!r}",
+              file=sys.stderr)
+        return 2
+    reg = get_registry()
+    try:
+        specs = ([_parse_tenant_spec(s) for s in args.tenant]
+                 if args.tenant else [(None, {})])
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    prev_chaos = None
+    if args.chaos:
+        prev_chaos = install_chaos(
+            ChaosMonkey.from_spec(args.chaos, registry=reg))
+    app = ServeApp(
+        registry=reg, io_threads=args.io_threads,
+        max_queue=args.max_queue, deadline_ms=args.deadline_ms,
+        linger_ms=args.linger_ms, group_cap=args.max_batch,
+        max_attempts=args.max_attempts,
+        retry_delay_ms=args.retry_delay_ms)
+    try:
+        for alias, ov in specs:
+            cfg = _build_config(args, ov)
+            alias = alias or cfg.name
+            if alias in app.tenants:
+                # caught BEFORE the (expensive) restore + AOT warmup —
+                # two specs deriving the same alias is a flag error
+                print(f"duplicate tenant alias {alias!r} — give each "
+                      "--tenant a distinct alias=", file=sys.stderr)
+                return 2
+            step = int(ov["step"]) if "step" in ov else args.step
+            t0 = time.perf_counter()
+            try:
+                tenant = Tenant(
+                    alias, cfg, checkpoint_dir(cfg, args.workdir),
+                    step=step, registry=reg, **_engine_kw(args, buckets))
+            except (FileNotFoundError, ValueError) as e:
+                print(f"tenant {alias!r}: {e}", file=sys.stderr)
+                return 1
+            tenant.warmup()
+            app.add_tenant(tenant)
+            print(f"tenant {alias!r}: checkpoint step {tenant.step}, "
+                  f"{len(tenant.engine.buckets)} bucket programs in "
+                  f"{time.perf_counter() - t0:.2f}s "
+                  f"(buckets {list(tenant.engine.buckets)})", flush=True)
+        return run_server(app, host, port,
+                          drain_timeout_s=args.drain_timeout)
+    finally:
+        if args.chaos:
+            install_chaos(prev_chaos)
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    import dataclasses
+    buckets = ([int(b) for b in args.buckets.split(",")] if args.buckets
+               else default_buckets(args.max_batch))
+    if args.http:
+        return _serve_http(args, buckets)
+    if not args.input_dir:
+        print("--input_dir is required in directory mode (or pass --http)",
+              file=sys.stderr)
+        return 2
 
-    from p2p_tpu.cli import apply_overrides as over
-    from p2p_tpu.cli.infer import _parse_mesh
-    from p2p_tpu.core.config import get_preset
     from p2p_tpu.data.generate import is_image_file
     from p2p_tpu.data.pipeline import load_image
     from p2p_tpu.serve import engine_from_checkpoint
+    from p2p_tpu.serve.frontend import DispatchLoop
+    from p2p_tpu.serve.tenancy import checkpoint_dir, serving_sample_batch
 
-    cfg = get_preset(args.preset)
+    cfg = _build_config(args)
     if cfg.data.n_frames > 1:
         print("serve covers image presets; use cli/infer.py for video",
               file=sys.stderr)
         return 2
-    data = over(cfg.data, dataset=args.dataset, image_size=args.image_size)
-    model = over(cfg.model, ngf=args.ngf, n_blocks=args.n_blocks)
-    health = over(cfg.health, ema_decay=args.ema_decay)
-    cfg = dataclasses.replace(cfg, data=data, model=model, health=health,
-                              name=args.name or cfg.name)
 
     h, w = cfg.image_hw
     as_uint8 = cfg.data.uint8_pipeline
 
-    def decode(path):
+    def decode_path(path):
         # eval semantics: the request image drives whichever slot the
         # preset reads (target for compression-net presets, input
         # otherwise); the engine's batch spec names the keys it compiled.
@@ -156,23 +310,11 @@ def main(argv=None) -> int:
         chaos_point("decode")
         return load_image(path, h, w, as_uint8=as_uint8)
 
-    buckets = ([int(b) for b in args.buckets.split(",")] if args.buckets
-               else default_buckets(args.max_batch))
-    ckpt_dir = os.path.join(
-        args.workdir, cfg.train.checkpoint_dir, cfg.data.dataset, cfg.name
-    )
-    sample = np.zeros((1, h, w, cfg.model.input_nc),
-                      np.uint8 if as_uint8 else np.float32)
-    sample_batch = {"input": sample, "target": sample}
     try:
         engine, step = engine_from_checkpoint(
-            cfg, ckpt_dir, sample_batch, step=args.step,
-            buckets=buckets, dtype=args.dtype,
-            mesh=_parse_mesh(args.mesh), tp_min_ch=args.tp_min_ch,
-            with_metrics=False,  # requests carry no ground truth
-            compilation_cache_dir=args.compilation_cache,
-            io_workers=args.io_threads,
-        )
+            cfg, checkpoint_dir(cfg, args.workdir),
+            serving_sample_batch(cfg),
+            step=args.step, **_engine_kw(args, buckets))
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -197,14 +339,18 @@ def main(argv=None) -> int:
     if args.chaos:
         prev_chaos = install_chaos(
             ChaosMonkey.from_spec(args.chaos, registry=reg))
+    # serve-side counters are tenant-tagged even in single-model directory
+    # mode (tenant = the model's name), so dashboards aggregate the two
+    # frontends identically and the summary attributes failures per model
+    tenant = cfg.name
     queue = BoundedRequestQueue(
         max_depth=args.max_queue,
         deadline_s=(args.deadline_ms / 1e3) if args.deadline_ms > 0 else None,
-        registry=reg,
+        registry=reg, tenant=tenant,
     )
     quarantine = Quarantine(
         args.quarantine_dir or os.path.join(args.input_dir, "failed"),
-        registry=reg,
+        registry=reg, tenant=tenant,
     )
     from p2p_tpu.serve import AsyncImageWriter
 
@@ -212,86 +358,54 @@ def main(argv=None) -> int:
     # target name, dead volume) is recorded + counted, never fatal — the
     # write-side analog of decode quarantine
     writer = AsyncImageWriter(args.io_threads, fail_fast=False)
-    served = 0
-    keys = list(engine.batch_keys)
     retry_delay = args.retry_delay_ms / 1e3
+    seen = set()
 
     # requests queue as NAMES (BoundedRequestQueue of file names); decode
     # happens per micro-batch at dispatch time (a 10k-file backlog must
     # not be decoded into host RAM — or delay the first response — before
-    # the first batch ships)
-    def dispatch(group_reqs):
-        """One micro-batch of requests: decode → engine → writer.
+    # the first batch ships). The dispatch/decode-retry/quarantine
+    # mechanics live in the shared DispatchLoop (serve/frontend.py);
+    # the callbacks below are the directory frontend's POLICY.
+    def decode_req(req):
+        return decode_path(os.path.join(args.input_dir, req.name))
 
-        A failed decode (file still being copied in, injected chaos, real
-        corruption) re-enters the queue with exponential backoff up to
-        --max_attempts; after that the file is MOVED to the quarantine
-        dir — capped attempts, and a permanently-corrupt input can never
-        be re-enqueued again. One bad request must never kill the server.
-        """
-        nonlocal served
-        group = []
-        for req in group_reqs:
-            path = os.path.join(args.input_dir, req.name)
-            try:
-                group.append((req, decode(path)))
-            except Exception as e:
-                req.attempts += 1
-                if req.attempts >= args.max_attempts:
-                    dest = quarantine.quarantine(
-                        path, f"{req.attempts} failed decodes; last: {e!r}")
-                    print(f"WARNING: quarantined request {req.name!r} "
-                          f"after {req.attempts} failed decodes → "
-                          f"{dest or 'GONE'}: {e}",
-                          file=sys.stderr, flush=True)
-                else:
-                    # exponential backoff on the re-enqueue — this IS the
-                    # decode retry path (the dispatch loop must not sleep,
-                    # so backoff lives in the queue, not a blocking
-                    # retry_call). A full queue sheds the retry; dropping
-                    # the name from `seen` lets a later, quieter scan
-                    # re-offer the file instead of stranding it unserved.
-                    if queue.requeue(
-                            req, retry_delay * (2.0 ** (req.attempts - 1))):
-                        reg.counter("retry_attempts_total",
-                                    seam="decode").inc()
-                    else:
-                        seen.discard(req.name)
-                        print(f"WARNING: queue full — decode retry for "
-                              f"{req.name!r} shed; the file stays in the "
-                              "input dir for a later scan",
-                              file=sys.stderr, flush=True)
-        if not group:
-            return
-        stack = np.stack([img for _, img in group])
-        batch = {k: stack for k in keys}
-        pred, _, n_real = engine.infer_batch(batch)
+    def deliver(reqs, pred, n_real):
         paths = [os.path.join(out_dir,
                               os.path.splitext(req.name)[0] + ".png")
-                 for req, _ in group]
+                 for req in reqs]
         writer.submit_batch(pred, paths)
-        served += len(group)
 
-    # a custom --buckets list may top out below --max_batch: micro-batches
-    # are capped at whichever is smaller, so dispatch never overflows the
-    # largest compiled bucket (engine.stream would chunk; infer_batch won't)
-    group_cap = min(args.max_batch, engine.buckets[-1])
+    def on_poison(req, e):
+        path = os.path.join(args.input_dir, req.name)
+        dest = quarantine.quarantine(
+            path, f"{req.attempts} failed decodes; last: {e!r}")
+        print(f"WARNING: quarantined request {req.name!r} "
+              f"after {req.attempts} failed decodes → "
+              f"{dest or 'GONE'}: {e}",
+              file=sys.stderr, flush=True)
 
-    def drain_queue():
-        """Dispatch everything currently DISPATCHABLE (not in a backoff
-        window); expired requests are dropped — an answer after the
-        deadline serves nobody — with their files left in place."""
-        while True:
-            ready, expired = queue.take(group_cap)
-            for req in expired:
-                print(f"note: request {req.name!r} exceeded its "
-                      f"{args.deadline_ms:.0f} ms deadline — dropped",
-                      file=sys.stderr, flush=True)
-            if not ready:
-                break
-            dispatch(ready)
+    def on_expired(req):
+        print(f"note: request {req.name!r} exceeded its "
+              f"{args.deadline_ms:.0f} ms deadline — dropped",
+              file=sys.stderr, flush=True)
 
-    seen = set()
+    def on_retry_shed(req):
+        # dropping the name from `seen` lets a later, quieter scan
+        # re-offer the file instead of stranding it unserved
+        seen.discard(req.name)
+        print(f"WARNING: queue full — decode retry for "
+              f"{req.name!r} shed; the file stays in the "
+              "input dir for a later scan",
+              file=sys.stderr, flush=True)
+
+    loop = DispatchLoop(
+        engine, queue, decode=decode_req, deliver=deliver,
+        on_poison=on_poison, on_expired=on_expired,
+        on_retry_shed=on_retry_shed, max_attempts=args.max_attempts,
+        retry_delay_s=retry_delay, registry=reg, tenant=tenant,
+        group_cap=args.max_batch,
+    )
 
     def scan():
         """Enqueue new arrivals; a full queue sheds them (counted). A
@@ -323,21 +437,22 @@ def main(argv=None) -> int:
     try:
         scan()
         if args.once:
-            drain_queue()
+            loop.drain()
             while len(queue):    # wait out retry-backoff windows, then finish
                 time.sleep(min(retry_delay / 2, 0.25))
-                drain_queue()
+                loop.drain()
         else:
             try:
                 linger_start = time.perf_counter() if len(queue) else None
-                while args.max_requests is None or served < args.max_requests:
+                while (args.max_requests is None
+                       or loop.served < args.max_requests):
                     if len(queue) >= args.max_batch or (
                         len(queue)
                         and linger_start is not None
                         and (time.perf_counter() - linger_start) * 1e3
                         >= args.linger_ms
                     ):
-                        drain_queue()
+                        loop.drain()
                         linger_start = None
                     time.sleep(args.poll_ms / 1e3 if not len(queue) else
                                args.linger_ms / 1e3)
@@ -345,7 +460,7 @@ def main(argv=None) -> int:
                     if len(queue) and linger_start is None:
                         linger_start = time.perf_counter()
             except KeyboardInterrupt:
-                drain_queue()
+                loop.drain()
         n_written = writer.drain()
         writer.close()
         for path, err in writer.write_errors:
@@ -358,8 +473,10 @@ def main(argv=None) -> int:
             install_chaos(prev_chaos)
     wall = time.perf_counter() - t0
 
+    occ = loop.occupancy_mean
     print(json.dumps({
-        "kind": "serve_summary", "served": served, "written": n_written,
+        "kind": "serve_summary", "tenant": tenant, "served": loop.served,
+        "written": n_written,
         "out_dir": out_dir, "buckets": list(engine.buckets),
         "n_compiles": engine.n_compiles,
         "encode_sec": round(writer.encode_sec, 4),
@@ -368,11 +485,12 @@ def main(argv=None) -> int:
         "deadline_expired": queue.expired_count,
         "quarantined": quarantine.count,
         "write_failures": len(writer.write_errors),
-        "decode_retries": int(reg.counter(
-            "retry_attempts_total", seam="decode").value),
+        "decode_retries": loop.decode_retries,
         "write_retries": int(reg.counter(
             "retry_attempts_total", seam="serve_write").value),
         "chaos_injected": int(reg.total("chaos_injected_total")),
+        "batch_occupancy_mean": round(occ, 4) if occ is not None else None,
+        "padded_images": loop.padded_images,
     }))
     return 0
 

@@ -67,6 +67,25 @@ def load_image(path: str, h: int, w: int,
             * np.float32(1.0 / 127.5))
 
 
+def load_image_bytes(data: bytes, h: int, w: int,
+                     as_uint8: bool = False) -> np.ndarray:
+    """:func:`load_image` over an in-memory encoded image — the HTTP
+    request body of the network serving frontend (serve/server.py).
+    Identical decode/resize/normalize semantics; no native fast path
+    (it is keyed on file paths) — PIL decodes from the bytes directly,
+    so a request never touches disk."""
+    import io
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    if img.size != (w, h):
+        img = img.resize((w, h), Image.BICUBIC)
+    arr = np.asarray(img, np.uint8)
+    if as_uint8:
+        return arr
+    return ((arr.astype(np.float32) - np.float32(127.5))
+            * np.float32(1.0 / 127.5))
+
+
 class PairedImageDataset:
     """Random-access paired dataset; items are dicts of HWC images —
     float32 [-1,1] by default, raw uint8 [0,255] with ``dtype='uint8'``

@@ -37,6 +37,7 @@ from p2p_tpu.obs.sinks import (
     Sink,
     StdoutSink,
     TensorBoardSink,
+    prometheus_exposition,
 )
 from p2p_tpu.obs.spans import (
     SpanRecorder,
@@ -81,6 +82,7 @@ __all__ = [
     "grad_norm_taps",
     "measure_rtt",
     "nan_sentinel",
+    "prometheus_exposition",
     "remove_sentinel_handler",
     "set_registry",
     "span",

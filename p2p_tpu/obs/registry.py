@@ -146,20 +146,27 @@ class EWMARate:
         self._clock = clock
         self._rate = float("nan")
         self._t_last: Optional[float] = None
+        self._lock = threading.Lock()
 
     def mark(self, n: float = 1.0) -> None:
+        # locked like Counter.inc/Histogram.observe: the HTTP serving
+        # frontend marks admission rates from N handler threads — an
+        # unguarded read-modify-write of (_t_last, _rate) would compute
+        # instantaneous rates over wrong intervals under exactly the
+        # concurrent load the series exists to measure
         now = self._clock()
-        if self._t_last is None:
+        with self._lock:
+            if self._t_last is None:
+                self._t_last = now
+                return
+            dt = max(now - self._t_last, 1e-9)
             self._t_last = now
-            return
-        dt = max(now - self._t_last, 1e-9)
-        self._t_last = now
-        inst = n / dt
-        if math.isnan(self._rate):
-            self._rate = inst
-        else:
-            alpha = 1.0 - 0.5 ** (dt / self.halflife_s)
-            self._rate += alpha * (inst - self._rate)
+            inst = n / dt
+            if math.isnan(self._rate):
+                self._rate = inst
+            else:
+                alpha = 1.0 - 0.5 ** (dt / self.halflife_s)
+                self._rate += alpha * (inst - self._rate)
 
     @property
     def rate(self) -> float:

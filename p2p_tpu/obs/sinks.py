@@ -156,6 +156,46 @@ def _prom_name(s: str) -> str:
     return ("p2p_" + out) if not out or out[0].isdigit() else out
 
 
+def prometheus_exposition(registry) -> str:
+    """A registry's metric state in the Prometheus text exposition
+    format — the ONE formatter behind both the textfile sink below and
+    the HTTP server's live ``GET /metrics`` endpoint (serve/server.py),
+    so a series scraped from either surface has identical names/labels.
+
+    Snapshot FIRST: sentinel-callback / compile-listener threads register
+    metrics concurrently, so a key can appear in a later ``kinds()`` that
+    a snapshot taken first won't have — never the reverse — and unknown
+    kinds are skipped rather than KeyError-ing the caller."""
+    lines = []
+    snap = sorted(registry.snapshot().items())
+    kinds = registry.kinds()
+    for key, fields in snap:
+        if key not in kinds:
+            continue
+        name, _, tagpart = key.partition("{")
+        labels = ""
+        if tagpart:
+            # registry keys carry tags as k=v,...} — the exposition
+            # format requires label VALUES quoted (k="v"), and one
+            # malformed line makes the collector drop the whole file
+            pairs = []
+            for kv in tagpart.rstrip("}").split(","):
+                k, _, v = kv.partition("=")
+                v = v.replace("\\", r"\\").replace('"', r"\"")
+                pairs.append(f'{_prom_name(k)}="{v}"')
+            labels = "{" + ",".join(pairs) + "}"
+        base = _prom_name(name)
+        ptype = {"counter": "counter", "ewma": "gauge",
+                 "gauge": "gauge", "histogram": "summary"}[kinds[key]]
+        lines.append(f"# TYPE {base} {ptype}")
+        for f, v in fields.items():
+            suffix = "" if f in ("value", "rate") else "_" + _prom_name(f)
+            if v != v:  # NaN gauges poison dashboards; skip them
+                continue
+            lines.append(f"{base}{suffix}{labels} {v}")
+    return "\n".join(lines) + "\n"
+
+
 class PrometheusTextfileSink(Sink):
     """Textfile-exporter format (node_exporter's ``--collector.textfile``).
 
@@ -191,43 +231,14 @@ class PrometheusTextfileSink(Sink):
     def export(self) -> None:
         if self._closed:
             return
-        lines = []
-        # snapshot FIRST: the sentinel-callback / compile-listener threads
-        # register metrics concurrently, so a key can appear in a later
-        # kinds() that a snapshot taken first won't have — never the
-        # reverse — and unknown kinds are skipped rather than KeyError-ing
-        # the training loop. The lock serializes the tmp-file rename
-        # against those same threads' force-records.
-        snap = sorted(self.registry.snapshot().items())
-        kinds = self.registry.kinds()
-        for key, fields in snap:
-            if key not in kinds:
-                continue
-            name, _, tagpart = key.partition("{")
-            labels = ""
-            if tagpart:
-                # registry keys carry tags as k=v,...} — the exposition
-                # format requires label VALUES quoted (k="v"), and one
-                # malformed line makes the collector drop the whole file
-                pairs = []
-                for kv in tagpart.rstrip("}").split(","):
-                    k, _, v = kv.partition("=")
-                    v = v.replace("\\", r"\\").replace('"', r"\"")
-                    pairs.append(f'{_prom_name(k)}="{v}"')
-                labels = "{" + ",".join(pairs) + "}"
-            base = _prom_name(name)
-            ptype = {"counter": "counter", "ewma": "gauge",
-                     "gauge": "gauge", "histogram": "summary"}[kinds[key]]
-            lines.append(f"# TYPE {base} {ptype}")
-            for f, v in fields.items():
-                suffix = "" if f in ("value", "rate") else "_" + _prom_name(f)
-                if v != v:  # NaN gauges poison dashboards; skip them
-                    continue
-                lines.append(f"{base}{suffix}{labels} {v}")
+        # formatted OUTSIDE the lock (prometheus_exposition snapshots the
+        # registry race-free); the lock serializes the tmp-file rename
+        # against other threads' force-records.
+        text = prometheus_exposition(self.registry)
         with self._lock:
             tmp = self.path + ".tmp"
             with open(tmp, "w") as f:
-                f.write("\n".join(lines) + "\n")
+                f.write(text)
             os.replace(tmp, self.path)  # atomic: scrapers never see torn files
 
     def flush(self) -> None:

@@ -32,21 +32,42 @@ import os
 import shutil
 import time
 from collections import deque
-from typing import Callable, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 
 @dataclasses.dataclass
 class Request:
-    """One queued request (a file name, for the directory frontend)."""
+    """One queued request: a file name for the directory frontend, or a
+    name plus an in-memory ``payload`` (the request body bytes) for the
+    HTTP frontend (serve/server.py)."""
 
     name: str
     enqueued_at: float
     attempts: int = 0
     not_before: float = 0.0   # backoff: don't dispatch before this time
+    payload: Any = None       # in-memory body; None = decode from disk
+    cost: int = 0             # queued payload bytes (byte-budget account)
 
 
 class BoundedRequestQueue:
-    """FIFO with a depth cap (shed-newest), deadlines, and retry re-entry."""
+    """FIFO with a depth cap (shed-newest), deadlines, and retry re-entry.
+
+    ``tenant`` tags every counter/gauge with ``tenant=<name>`` so the
+    multi-model serving process (serve/tenancy.py) attributes shedding,
+    deadline expiry and queue pressure PER MODEL instead of reading
+    process-global totals; None keeps the untagged metric names.
+
+    ``max_bytes`` additionally bounds the SUM of queued payload bytes
+    (``Request.payload``) — the HTTP frontend queues whole request
+    bodies, so a count-only cap would admit ``max_depth × body-size``
+    of host RAM; an admission that would exceed the budget sheds like
+    a depth overflow. The directory frontend queues names only (zero
+    cost) and is unaffected.
+
+    Not thread-safe by itself — the directory frontend is single-threaded
+    and the HTTP frontend serializes access through
+    :class:`p2p_tpu.serve.batcher.ContinuousBatcher`'s condition lock.
+    """
 
     def __init__(
         self,
@@ -54,20 +75,27 @@ class BoundedRequestQueue:
         deadline_s: Optional[float] = None,
         registry=None,
         clock: Callable[[], float] = time.monotonic,
+        tenant: Optional[str] = None,
+        max_bytes: Optional[int] = None,
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = max_depth
         self.deadline_s = deadline_s
+        self.tenant = tenant
+        self.max_bytes = max_bytes
+        self.queued_bytes = 0
         self._clock = clock
         self._q: deque = deque()
         if registry is None:
             from p2p_tpu.obs import get_registry
 
             registry = get_registry()
-        self._shed = registry.counter("serve_shed_total")
-        self._expired = registry.counter("serve_deadline_expired_total")
-        self._depth = registry.gauge("serve_queue_depth")
+        tags = {"tenant": tenant} if tenant else {}
+        self._shed = registry.counter("serve_shed_total", **tags)
+        self._expired = registry.counter("serve_deadline_expired_total",
+                                         **tags)
+        self._depth = registry.gauge("serve_queue_depth", **tags)
 
     def __len__(self) -> int:
         return len(self._q)
@@ -80,28 +108,52 @@ class BoundedRequestQueue:
     def expired_count(self) -> int:
         return int(self._expired.value)
 
-    def offer(self, name: str) -> bool:
-        """Enqueue a fresh request; returns False (and counts a shed) when
-        the queue is full — under overload the newest arrivals are the
-        ones turned away, they waited least."""
-        if len(self._q) >= self.max_depth:
+    def offer(self, name: str,
+              payload: Any = None) -> Optional[Request]:
+        """Enqueue a fresh request; returns the queued :class:`Request`
+        (truthy), or None (and counts a shed) when the queue is full —
+        under overload the newest arrivals are the ones turned away, they
+        waited least."""
+        return self.offer_request(Request(name, 0.0, payload=payload))
+
+    def offer_request(self, req: Request) -> Optional[Request]:
+        """Enqueue a caller-built request (the HTTP frontend's response-
+        carrying subclass); stamps ``enqueued_at`` at admission so the
+        deadline clock starts here. Sheds when the depth cap — or the
+        payload byte budget — is exceeded, like :meth:`offer`."""
+        req.cost = (len(req.payload)
+                    if isinstance(req.payload, (bytes, bytearray)) else 0)
+        if len(self._q) >= self.max_depth or (
+                self.max_bytes is not None
+                and self.queued_bytes + req.cost > self.max_bytes):
             self._shed.inc()
             self._depth.set(len(self._q))
-            return False
-        self._q.append(Request(name, self._clock()))
+            return None
+        req.enqueued_at = self._clock()
+        self._q.append(req)
+        self.queued_bytes += req.cost
         self._depth.set(len(self._q))
-        return True
+        return req
+
+    def oldest_enqueued_at(self) -> Optional[float]:
+        """Arrival time of the request at the head of the queue (None
+        when empty) — the continuous batcher's linger clock: a forming
+        group dispatches once the OLDEST member has waited the linger."""
+        return self._q[0].enqueued_at if self._q else None
 
     def requeue(self, req: Request, delay_s: float = 0.0) -> bool:
         """Re-enter a failed request (attempt accounting is the caller's —
         bump ``req.attempts`` before requeueing). Sheds when full, like
         any arrival; keeps its ORIGINAL enqueue time so the deadline
         covers total time-in-system, not time-since-last-retry."""
-        if len(self._q) >= self.max_depth:
+        if len(self._q) >= self.max_depth or (
+                self.max_bytes is not None
+                and self.queued_bytes + req.cost > self.max_bytes):
             self._shed.inc()
             return False
         req.not_before = self._clock() + max(0.0, delay_s)
         self._q.append(req)
+        self.queued_bytes += req.cost
         self._depth.set(len(self._q))
         return True
 
@@ -128,8 +180,24 @@ class BoundedRequestQueue:
                 ready.append(req)
         for req in reversed(waiting):
             self._q.appendleft(req)   # preserve FIFO order among survivors
+        for req in ready:
+            self.queued_bytes -= req.cost
+        for req in expired:
+            self.queued_bytes -= req.cost
         self._depth.set(len(self._q))
         return ready, expired
+
+    def flush(self) -> List[Request]:
+        """Dequeue EVERYTHING — including requests inside retry-backoff
+        windows that :meth:`take` deliberately holds back. The drain-
+        timeout path uses this so a stuck-in-backoff straggler is still
+        ANSWERED (503) at shutdown instead of abandoned with its handler
+        thread."""
+        out = list(self._q)
+        self._q.clear()
+        self.queued_bytes = 0
+        self._depth.set(0)
+        return out
 
 
 class Quarantine:
@@ -141,13 +209,15 @@ class Quarantine:
     the move itself failed (the file may have vanished; never raises into
     the serve loop)."""
 
-    def __init__(self, directory: str, registry=None):
+    def __init__(self, directory: str, registry=None,
+                 tenant: Optional[str] = None):
         self.directory = directory
         if registry is None:
             from p2p_tpu.obs import get_registry
 
             registry = get_registry()
-        self._count = registry.counter("serve_quarantined_total")
+        tags = {"tenant": tenant} if tenant else {}
+        self._count = registry.counter("serve_quarantined_total", **tags)
         self._registry = registry
 
     @property

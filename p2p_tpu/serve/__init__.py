@@ -1,32 +1,61 @@
-"""High-throughput inference engine (the serving half of the north star).
+"""High-throughput inference serving (the serving half of the north star).
 
 - :class:`.engine.InferenceEngine` — AOT-compiled, bucket-batched generator
   serving with params-only restore, pipelined host I/O, bf16 / frozen-int8
-  dtype policies and optional tensor-parallel sharding;
+  dtype policies, optional tensor-parallel sharding, and zero-downtime
+  weight hot-swap (:meth:`.engine.InferenceEngine.swap_state`);
 - :func:`.engine.engine_from_checkpoint` — template + subtree restore +
   engine in one call (the cli/infer.py and cli/serve.py construction path);
-- :mod:`.io` — bucket padding/chunking and the threaded image writer.
+- :mod:`.frontend` — the shared dispatch/decode-retry/quarantine loop
+  behind the directory and HTTP frontends, with bucket-occupancy
+  accounting;
+- :mod:`.batcher` — continuous cross-request batching (thread-safe
+  admission, bucket-aware group formation, linger-when-under-full);
+- :mod:`.tenancy` — the multi-model registry: N checkpoints resident in
+  one process, each hot-swappable under traffic;
+- :mod:`.server` — the stdlib HTTP frontend (``POST /v1/{model}/translate``,
+  ``/healthz``, Prometheus ``/metrics``, ``POST /admin/reload``) with
+  PreemptionGuard-style graceful drain;
+- :mod:`.io` — bucket padding/chunking, the threaded image writer, and
+  PNG-bytes response encoding.
 
 See docs/SERVING.md.
 """
 
+from p2p_tpu.serve.batcher import ContinuousBatcher
 from p2p_tpu.serve.engine import (
     InferenceEngine,
     ServeStats,
     engine_from_checkpoint,
 )
+from p2p_tpu.serve.frontend import DispatchLoop, default_buckets
 from p2p_tpu.serve.io import (
     AsyncImageWriter,
     chunk_batch,
+    encode_png,
     pad_batch,
     pick_bucket,
+)
+from p2p_tpu.serve.tenancy import (
+    HotSwapRejected,
+    ModelRegistry,
+    Tenant,
+    checkpoint_dir,
 )
 
 __all__ = [
     "AsyncImageWriter",
+    "ContinuousBatcher",
+    "DispatchLoop",
+    "HotSwapRejected",
     "InferenceEngine",
+    "ModelRegistry",
     "ServeStats",
+    "Tenant",
+    "checkpoint_dir",
     "chunk_batch",
+    "default_buckets",
+    "encode_png",
     "engine_from_checkpoint",
     "pad_batch",
     "pick_bucket",

@@ -1,0 +1,166 @@
+"""Continuous cross-request batching — iteration-level scheduling at
+image-batch granularity (the Orca/vLLM insight applied to a GAN image
+service).
+
+The directory frontend groups whatever one directory scan returned; under
+concurrent network traffic that policy leaves buckets half-empty or
+requests waiting a full poll interval. :class:`ContinuousBatcher` instead
+admits requests the moment they arrive (N producer threads — the HTTP
+handler pool — feed one :class:`~p2p_tpu.resilience.queue.
+BoundedRequestQueue` through a condition lock) and forms a group every
+dispatch tick:
+
+- **loaded** (queue >= group_cap): a full largest-bucket group, NOW —
+  under sustained traffic every dispatch runs at occupancy 1.0;
+- **under-full**: linger up to ``linger_s`` measured from the OLDEST
+  queued request, admitting stragglers into the forming group;
+- **linger expired**: dispatch the largest FULL bucket that fits the
+  queue depth (the remainder follows immediately in a smaller bucket at
+  full occupancy) — only a depth below the smallest bucket ever pads.
+
+The batcher is the single synchronization point between producers and
+the per-tenant dispatch thread: every queue operation happens inside its
+condition, so the underlying queue keeps its simple single-thread
+implementation. Shed/deadline/backoff semantics are entirely the
+queue's; occupancy accounting is the dispatch loop's
+(:mod:`p2p_tpu.serve.frontend`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+from p2p_tpu.resilience.queue import BoundedRequestQueue, Request
+
+
+class ContinuousBatcher:
+    """Thread-safe admission + bucket-aware group formation over a
+    bounded request queue. One consumer (the tenant's dispatch thread)
+    calls :meth:`next_group`/:meth:`take`; any number of producers call
+    :meth:`submit`/:meth:`submit_request`."""
+
+    def __init__(
+        self,
+        queue: BoundedRequestQueue,
+        buckets: Sequence[int],
+        group_cap: Optional[int] = None,
+        linger_s: float = 0.05,
+        clock=time.monotonic,
+    ):
+        self.queue = queue
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"bad buckets {self.buckets}")
+        cap = self.buckets[-1]
+        self.group_cap = min(int(group_cap), cap) if group_cap else cap
+        self.linger_s = max(0.0, float(linger_s))
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # ------------------------------------------------------------ produce
+    def submit(self, name: str, payload: Any = None) -> Optional[Request]:
+        """Admit a fresh request; None = shed (queue full) or closed
+        (draining) — the HTTP handler maps those to 429/503."""
+        with self._cond:
+            if self._closed:
+                return None
+            req = self.queue.offer(name, payload=payload)
+            if req is not None:
+                self._cond.notify()
+            return req
+
+    def submit_request(self, req: Request) -> Optional[Request]:
+        """Admit a caller-built request (the HTTP frontend's response-
+        carrying subclass); same shed/closed contract as :meth:`submit`."""
+        with self._cond:
+            if self._closed:
+                return None
+            out = self.queue.offer_request(req)
+            if out is not None:
+                self._cond.notify()
+            return out
+
+    def requeue(self, req: Request, delay_s: float = 0.0) -> bool:
+        """Decode-retry re-entry (DispatchLoop calls this through the
+        queue surface); locked against concurrent producers."""
+        with self._cond:
+            ok = self.queue.requeue(req, delay_s)
+            if ok:
+                self._cond.notify()
+            return ok
+
+    # ------------------------------------------------------------ consume
+    def take(self, n: int) -> Tuple[List[Request], List[Request]]:
+        """Locked pass-through of the queue's take — the drain path."""
+        with self._cond:
+            return self.queue.take(n)
+
+    def flush(self) -> List[Request]:
+        """Locked pass-through of the queue's flush — the drain-timeout
+        path's answer-everything escape (backoff windows included)."""
+        with self._cond:
+            return self.queue.flush()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self.queue)
+
+    def close(self) -> None:
+        """Stop admitting (drain mode): submits return None, blocked
+        :meth:`next_group` calls wake and fall through to immediate
+        takes so the dispatch thread can finish the backlog."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _group_size(self, now: float) -> Tuple[int, Optional[float]]:
+        """(size, wait): size > 0 = dispatch that many now; else wait is
+        how long until the pending linger expires (None = queue empty,
+        wait for an arrival). Called under the condition."""
+        n = len(self.queue)
+        if n == 0:
+            return 0, None
+        if n >= self.group_cap:
+            return self.group_cap, None
+        oldest = self.queue.oldest_enqueued_at()
+        waited = now - (oldest if oldest is not None else now)
+        if waited >= self.linger_s:
+            full = [b for b in self.buckets if b <= n]
+            return (full[-1] if full else n), None
+        return 0, self.linger_s - waited
+
+    def next_group(self, timeout: float = 0.1
+                   ) -> Tuple[List[Request], List[Request]]:
+        """Block until a group is ready (or ``timeout``); returns
+        ``(ready, expired)`` — both possibly empty. Requests held inside
+        retry-backoff windows never busy-spin the consumer: when the
+        queue looks dispatchable but ``take`` comes back empty, the wait
+        resumes instead of looping hot."""
+        deadline = self._clock() + max(0.0, timeout)
+        with self._cond:
+            while not self._closed:
+                now = self._clock()
+                size, linger_wait = self._group_size(now)
+                if size > 0:
+                    ready, expired = self.queue.take(size)
+                    if ready or expired:
+                        return ready, expired
+                    # everything apparently-ready sits in a backoff
+                    # window — wait a beat rather than spin on take()
+                    linger_wait = max(self.linger_s, 0.01)
+                remaining = deadline - now
+                if remaining <= 0:
+                    return [], []
+                wait = (remaining if linger_wait is None
+                        else min(remaining, linger_wait))
+                self._cond.wait(max(wait, 1e-3))
+            # closed: hand back whatever is immediately dispatchable so
+            # the drain loop can run the backlog down and exit
+            return self.queue.take(self.group_cap)

@@ -199,6 +199,59 @@ class InferenceEngine:
         self.aot_sec += time.perf_counter() - t0
         return self
 
+    # ----------------------------------------------------------- hot-swap
+    def swap_state(self, new_state: InferState, warm: bool = True) -> None:
+        """Atomically swap the serving weights under the ALREADY-compiled
+        bucket programs — the zero-downtime half of checkpoint hot-swap
+        (serve/tenancy.py; docs/SERVING.md "Hot-swap").
+
+        The compiled executables close over shapes/dtypes, not values:
+        any state with the identical abstract tree serves through them
+        with ZERO new compiles. The swap
+
+        1. REJECTS (ValueError) a state whose structure, shapes or dtypes
+           differ from the live one — the old weights keep serving;
+        2. places the new tree on device through the engine's shardings
+           (the TP path lands shards directly in place) and blocks until
+           the H2D transfer completes — the first post-swap request never
+           pays the transfer;
+        3. with ``warm=True``, runs one zero-batch through the smallest
+           compiled bucket, proving the new params EXECUTE against the
+           compiled programs before any request can see them (a failure
+           here raises and leaves the old state serving);
+        4. swaps the state reference — one atomic attribute write, so a
+           concurrent in-flight :meth:`infer_batch` (which reads the
+           reference once) finishes on the OLD weights and the next
+           dispatch sees the new ones. No lock on the serving path.
+        """
+        old = jax.tree_util.tree_leaves_with_path(self.state)
+        new = jax.tree_util.tree_leaves_with_path(new_state)
+        if len(old) != len(new):
+            raise ValueError(
+                f"hot-swap rejected: new state has {len(new)} leaves, "
+                f"serving state has {len(old)} — different model family "
+                "or EMA/quant policy; start a new tenant instead")
+        for (po, lo), (pn, ln) in zip(old, new):
+            if po != pn or tuple(lo.shape) != tuple(ln.shape) \
+                    or lo.dtype != ln.dtype:
+                raise ValueError(
+                    "hot-swap rejected: leaf "
+                    f"{jax.tree_util.keystr(pn)} is "
+                    f"{ln.shape}/{ln.dtype}, serving state has "
+                    f"{jax.tree_util.keystr(po)} {lo.shape}/{lo.dtype} — "
+                    "the compiled bucket programs cannot serve it")
+        if self._state_shardings is not None:
+            new_state = jax.device_put(new_state, self._state_shardings)
+        else:
+            new_state = jax.device_put(new_state)
+        jax.block_until_ready(new_state)
+        if warm and self._compiled:
+            b = min(self._compiled)
+            zeros = {k: np.zeros(s.shape, s.dtype)
+                     for k, s in self._abstract_batch(b).items()}
+            jax.block_until_ready(self._compiled[b](new_state, zeros))
+        self.state = new_state
+
     # ------------------------------------------------------------ serving
     def infer_batch(self, host_batch: Dict[str, np.ndarray]):
         """Pad one host batch to its bucket and dispatch (async). Returns

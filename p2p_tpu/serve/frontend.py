@@ -1,0 +1,208 @@
+"""Shared serving frontend machinery — the dispatch / decode-retry /
+quarantine loop, factored out of ``cli/serve.py`` so the directory-
+watching frontend and the HTTP frontend (:mod:`p2p_tpu.serve.server`)
+run the SAME hardened request lifecycle over different transports.
+
+One :class:`DispatchLoop` instance per tenant owns:
+
+- **decode with retry + poison handling** — a failed decode (file still
+  being copied in, injected ``decode`` chaos, real corruption) re-enters
+  the queue with exponential backoff up to ``max_attempts``, then the
+  request is handed to the frontend's ``on_poison`` callback (the
+  directory frontend MOVES the file to quarantine; the HTTP frontend
+  answers 422). One bad request can never wedge or kill the server.
+- **bucketed dispatch** — a decoded group stacks into one host batch,
+  pads to an AOT-compiled bucket (engine.infer_batch), and hands the
+  DEVICE prediction to the frontend's ``deliver`` callback (directory:
+  async file writer; HTTP: D2H + PNG encode + response completion).
+- **occupancy accounting** — per dispatch, the real/padded split is
+  recorded on the obs registry (``serve_batch_occupancy`` histogram in
+  [0, 1] + ``serve_padded_images_total``), tenant-tagged, so the
+  continuous batcher's efficiency claim is measurable, not asserted.
+
+The loop is single-consumer by design: exactly ONE thread per tenant
+calls :meth:`DispatchLoop.dispatch`/:meth:`drain`. Producers feed the
+queue concurrently through the batcher's condition lock
+(:mod:`p2p_tpu.serve.batcher`); the directory frontend is fully
+single-threaded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2p_tpu.resilience.queue import Request
+
+#: serve_batch_occupancy histogram bounds — occupancy lives in (0, 1],
+#: and the interesting resolution is "which fraction of the bucket was
+#: real": sixteenths at the low end, eighths above.
+OCCUPANCY_BOUNDS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                    0.875, 1.0)
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """1, 2, 4, ... up to (and including) max_batch — a request group of
+    any size <= max_batch pads to at most 2× its images. Non-power-of-two
+    ``max_batch`` keeps the power-of-two ladder below it and appends
+    itself as the top bucket (pinned by tests/test_serve.py)."""
+    b, out = 1, []
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(sorted(set(out)))
+
+
+class DispatchLoop:
+    """The dispatch/decode-retry/quarantine loop shared by both frontends.
+
+    ``queue`` is anything with the :class:`~p2p_tpu.resilience.queue.
+    BoundedRequestQueue` take/requeue/len surface — the directory
+    frontend passes the queue itself, the HTTP frontend passes the
+    :class:`~p2p_tpu.serve.batcher.ContinuousBatcher` wrapping it (whose
+    requeue/take lock against concurrent producer threads).
+
+    Callbacks (all per-frontend policy; the loop owns only mechanics):
+
+    - ``decode(req) -> np.ndarray`` — raises on failure (retried).
+    - ``deliver(reqs, pred, n_real)`` — the dispatched DEVICE prediction
+      batch; rows ``[:n_real]`` correspond to ``reqs`` in order.
+    - ``on_poison(req, exc)`` — ``max_attempts`` decodes failed.
+    - ``on_expired(req)`` — deadline passed at dispatch time.
+    - ``on_retry_shed(req)`` — a decode retry found the queue full.
+    - ``on_engine_error(reqs, exc)`` — infer/deliver raised for the
+      DECODED group (requests whose decode failed were already
+      requeued/poisoned and are NOT in ``reqs`` — answering them too
+      would leave zombies in the queue). None (directory mode) re-raises.
+    """
+
+    def __init__(
+        self,
+        engine,
+        queue,
+        *,
+        decode: Callable[[Request], np.ndarray],
+        deliver: Callable[[Sequence[Request], object, int], None],
+        on_poison: Callable[[Request, BaseException], None],
+        on_expired: Optional[Callable[[Request], None]] = None,
+        on_retry_shed: Optional[Callable[[Request], None]] = None,
+        on_engine_error=None,
+        max_attempts: int = 3,
+        retry_delay_s: float = 1.0,
+        registry=None,
+        tenant: Optional[str] = None,
+        group_cap: Optional[int] = None,
+    ):
+        self.engine = engine
+        self.queue = queue
+        self._decode = decode
+        self._deliver = deliver
+        self._on_poison = on_poison
+        self._on_expired = on_expired
+        self._on_retry_shed = on_retry_shed
+        self._on_engine_error = on_engine_error
+        self.max_attempts = max(1, int(max_attempts))
+        self.retry_delay_s = retry_delay_s
+        self.tenant = tenant
+        # a custom bucket list may top out below the frontend's batch cap:
+        # groups cap at whichever is smaller, so dispatch never overflows
+        # the largest compiled bucket (engine.stream would chunk;
+        # infer_batch won't)
+        cap = engine.buckets[-1]
+        self.group_cap = min(int(group_cap), cap) if group_cap else cap
+        if registry is None:
+            from p2p_tpu.obs import get_registry
+
+            registry = get_registry()
+        self.registry = registry
+        tags = {"tenant": tenant} if tenant else {}
+        self._retries = registry.counter("retry_attempts_total",
+                                         seam="decode", **tags)
+        self._occupancy = registry.histogram(
+            "serve_batch_occupancy", bounds=OCCUPANCY_BOUNDS, **tags)
+        self._padded = registry.counter("serve_padded_images_total", **tags)
+        self._batches = registry.counter("serve_batches_total", **tags)
+        self.served = 0
+
+    @property
+    def decode_retries(self) -> int:
+        return int(self._retries.value)
+
+    @property
+    def padded_images(self) -> int:
+        return int(self._padded.value)
+
+    @property
+    def occupancy_mean(self) -> Optional[float]:
+        """Mean bucket occupancy over every dispatch (None before the
+        first) — the padding-waste headline the summaries report."""
+        h = self._occupancy
+        return (h.sum / h.count) if h.count else None
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch(self, group_reqs: Sequence[Request]) -> int:
+        """One micro-batch of requests: decode → engine → deliver.
+
+        Failed decodes re-enter the queue with exponential backoff up to
+        ``max_attempts``, then go to ``on_poison`` — capped attempts, and
+        a permanently-poison request can never be re-enqueued again.
+        Returns the number of requests dispatched to the engine."""
+        group = []
+        for req in group_reqs:
+            try:
+                group.append((req, self._decode(req)))
+            except Exception as e:
+                req.attempts += 1
+                if req.attempts >= self.max_attempts:
+                    self._on_poison(req, e)
+                else:
+                    # exponential backoff on the re-enqueue — this IS the
+                    # decode retry path (the dispatch loop must not
+                    # sleep, so backoff lives in the queue, not a
+                    # blocking retry_call). A full queue sheds the retry.
+                    delay = self.retry_delay_s * (2.0 ** (req.attempts - 1))
+                    if self.queue.requeue(req, delay):
+                        self._retries.inc()
+                    elif self._on_retry_shed is not None:
+                        self._on_retry_shed(req)
+        if not group:
+            return 0
+        reqs = [r for r, _ in group]
+        try:
+            stack = np.stack([img for _, img in group])
+            batch = {k: stack for k in self.engine.batch_keys}
+            pred, _, n_real = self.engine.infer_batch(batch)
+            # padded-vs-real accounting: the dispatched bucket is the
+            # padded leading dim the engine actually ran — occupancy is
+            # the fraction of it that was real requests, padding is pure
+            # waste the continuous batcher exists to minimize
+            bucket = int(pred.shape[0])
+            self._occupancy.observe(n_real / bucket)
+            self._padded.inc(bucket - n_real)
+            self._batches.inc()
+            self._deliver(reqs, pred, n_real)
+        except BaseException as e:
+            if self._on_engine_error is None:
+                raise
+            # only the DECODED group dies here; decode-failed members
+            # already left via requeue/poison above
+            self._on_engine_error(reqs, e)
+            return 0
+        self.served += len(group)
+        return len(group)
+
+    def drain(self) -> int:
+        """Dispatch everything currently DISPATCHABLE (not in a backoff
+        window); expired requests go to ``on_expired`` — an answer after
+        the deadline serves nobody. Returns requests dispatched."""
+        n = 0
+        while True:
+            ready, expired = self.queue.take(self.group_cap)
+            if self._on_expired is not None:
+                for req in expired:
+                    self._on_expired(req)
+            if not ready:
+                return n
+            n += self.dispatch(ready)

@@ -62,6 +62,23 @@ def save_img_atomic(arr, path: str) -> None:
         raise
 
 
+def encode_png(arr) -> bytes:
+    """One prediction image ([-1,1] float HWC) PNG-encoded to bytes — the
+    HTTP frontend's response body (serve/server.py). Same uint8
+    conversion as :func:`~p2p_tpu.utils.images.save_img`, so a response
+    body is byte-identical to the file the directory frontend would have
+    written for the same prediction."""
+    import io as _io
+
+    from PIL import Image
+
+    from p2p_tpu.utils.images import to_uint8_img
+
+    buf = _io.BytesIO()
+    Image.fromarray(to_uint8_img(arr)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
 def pick_bucket(n: int, buckets: Sequence[int]) -> int:
     """Smallest bucket >= n (buckets must be sorted ascending; callers
     chunk anything larger than the biggest bucket first)."""

@@ -365,6 +365,17 @@ class CheckpointManager:
               f"({reason}) — falling back to the previous intact step",
               flush=True)
 
+    def verify_integrity(self, step: int, restored: Any) -> List[str]:
+        """Verify any restored (sub)tree against ``step``'s save-time
+        manifest; returns the mismatched leaf paths (empty = intact or
+        unverifiable). Leaves absent from ``restored`` (a params-only
+        subtree) or with a different recorded shape/dtype (a cast
+        restore) are skipped. The serving hot-swap path
+        (p2p_tpu.serve.tenancy) verifies exactly the subtree it is about
+        to swap in, so a torn/bit-rotted upload is rejected BEFORE it
+        replaces live weights — the old engine keeps serving."""
+        return self._verify_integrity(int(step), restored)
+
     def integrity_manifest(self, step: int) -> Optional[Dict[str, Any]]:
         """The save-time (or migration-regenerated) integrity manifest
         for ``step`` — {step, algo, leaves: {path: {crc32, shape,

@@ -1,0 +1,425 @@
+"""Network-native serving (serve/batcher, tenancy, server) — the
+continuous-batching + multi-tenant + hot-swap contracts of
+docs/SERVING.md "HTTP API":
+
+- continuous batcher: full groups under load, linger when under-full,
+  largest-FULL-bucket formation after linger (padding only below the
+  smallest bucket), shed-on-full admission, drain-on-close;
+- hot-swap: new weights serve through the ALREADY-compiled bucket
+  programs (zero new compiles, outputs change), an integrity-manifest
+  mismatch REJECTS the swap with the old engine still serving, and a
+  shape-mismatched state is refused at the engine;
+- HTTP server: translate round-trip (response PNG == the directory
+  frontend's file bytes), 404/422/429 ladder, /healthz, live /metrics
+  exposition, admin reload, graceful drain exit.
+"""
+
+import dataclasses
+import io
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+
+from p2p_tpu.core.config import get_preset
+from p2p_tpu.data.synthetic import synthetic_batch
+from p2p_tpu.obs import MetricsRegistry, set_registry
+from p2p_tpu.resilience.queue import BoundedRequestQueue
+from p2p_tpu.serve import ContinuousBatcher, default_buckets
+from p2p_tpu.serve.tenancy import (
+    HotSwapRejected,
+    Tenant,
+    checkpoint_dir,
+)
+from p2p_tpu.train.checkpoint import CheckpointManager
+from p2p_tpu.train.state import create_train_state
+
+
+@pytest.fixture()
+def fresh_registry():
+    prev = set_registry(MetricsRegistry())
+    yield
+    set_registry(prev)
+
+
+# ------------------------------------------------------ continuous batcher
+def _batcher(buckets=(1, 2, 4), linger_s=0.02, max_depth=32):
+    q = BoundedRequestQueue(max_depth, registry=MetricsRegistry())
+    return ContinuousBatcher(q, buckets, linger_s=linger_s)
+
+
+def test_batcher_full_group_dispatches_immediately():
+    b = _batcher()
+    for i in range(5):
+        assert b.submit(f"r{i}") is not None
+    t0 = time.monotonic()
+    ready, expired = b.next_group(timeout=1.0)
+    # a loaded queue forms the largest (group_cap) group with no linger
+    assert [r.name for r in ready] == ["r0", "r1", "r2", "r3"]
+    assert not expired and time.monotonic() - t0 < 0.5
+
+
+def test_batcher_lingers_then_forms_largest_full_bucket():
+    b = _batcher(linger_s=0.03)
+    for i in range(3):
+        b.submit(f"r{i}")
+    t0 = time.monotonic()
+    ready, _ = b.next_group(timeout=2.0)
+    waited = time.monotonic() - t0
+    # 3 queued < group_cap 4: linger, then the largest FULL bucket <= 3
+    # (bucket 2) dispatches at occupancy 1.0...
+    assert [r.name for r in ready] == ["r0", "r1"]
+    assert waited >= 0.02
+    # ...and the remainder follows immediately in bucket 1 (its linger —
+    # measured from ARRIVAL — already expired)
+    ready, _ = b.next_group(timeout=2.0)
+    assert [r.name for r in ready] == ["r2"]
+
+
+def test_batcher_straggler_joins_forming_group():
+    b = _batcher(linger_s=0.25)
+    b.submit("r0")
+    got = {}
+
+    def consume():
+        got["ready"] = b.next_group(timeout=5.0)[0]
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    for i in range(1, 4):
+        b.submit(f"r{i}")  # completes the bucket-4 group mid-linger
+    t.join(5.0)
+    assert [r.name for r in got["ready"]] == ["r0", "r1", "r2", "r3"]
+
+
+def test_batcher_sheds_when_full_and_rejects_after_close():
+    b = _batcher(max_depth=2)
+    assert b.submit("a") and b.submit("b")
+    assert b.submit("c") is None            # shed (counted by the queue)
+    assert b.queue.shed_count == 1
+    b.close()
+    assert b.submit("d") is None            # draining: no new admissions
+    # close() hands the backlog straight back so the drain loop finishes
+    ready, _ = b.next_group(timeout=0.2)
+    assert [r.name for r in ready] == ["a", "b"]
+    assert len(b) == 0
+
+
+def test_queue_byte_budget_sheds_oversize_payloads():
+    """HTTP bodies ride the queue: the byte budget bounds host RAM where
+    a depth-only cap would admit max_queue × body-size."""
+    q = BoundedRequestQueue(10, registry=MetricsRegistry(), max_bytes=100)
+    assert q.offer("a", payload=b"x" * 60)
+    assert q.offer("b", payload=b"x" * 60) is None    # budget, not depth
+    assert q.shed_count == 1
+    assert q.queued_bytes == 60
+    ready, _ = q.take(10)
+    assert [r.name for r in ready] == ["a"] and q.queued_bytes == 0
+    assert q.offer("c", payload=b"x" * 60)            # budget released
+
+
+def test_queue_flush_returns_backoff_holdouts():
+    """flush() (the drain-timeout path) pulls requests take() would hold
+    back inside their retry-backoff window — answered, not abandoned."""
+    q = BoundedRequestQueue(10, registry=MetricsRegistry())
+    q.offer("a")
+    ready, _ = q.take(1)
+    ready[0].attempts += 1
+    assert q.requeue(ready[0], delay_s=60.0)
+    assert q.take(10) == ([], [])            # backing off: held
+    assert [r.name for r in q.flush()] == ["a"]
+    assert len(q) == 0 and q.queued_bytes == 0
+
+
+# --------------------------------------------- default_buckets / group cap
+def test_default_buckets_non_power_of_two_max_batch():
+    # the power-of-two ladder keeps every tail coverable, and a
+    # non-power-of-two cap appends itself as the top bucket
+    assert default_buckets(6) == (1, 2, 4, 6)
+    assert default_buckets(5) == (1, 2, 4, 5)
+    assert default_buckets(16) == (1, 2, 4, 8, 16)
+    assert default_buckets(1) == (1,)
+
+
+def _unet_config(**model_kw):
+    from p2p_tpu.core.config import (
+        Config,
+        DataConfig,
+        LossConfig,
+        ModelConfig,
+        OptimConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+    from p2p_tpu.core.mesh import MeshSpec
+
+    kw = dict(generator="unet", ngf=8, ndf=8, num_D=1, n_layers_D=2,
+              use_spectral_norm=False, use_compression_net=False)
+    kw.update(model_kw)
+    return Config(
+        name="tinyunet",
+        model=ModelConfig(**kw),
+        loss=LossConfig(lambda_feat=0.0, lambda_vgg=0.0, lambda_tv=0.0,
+                        lambda_l1=100.0),
+        optim=OptimConfig(niter=2, niter_decay=2),
+        data=DataConfig(batch_size=2, image_size=32, test_batch_size=2),
+        parallel=ParallelConfig(mesh=MeshSpec(data=1)),
+        train=TrainConfig(seed=0, mixed_precision=False),
+    )
+
+
+def test_dispatch_loop_group_cap_and_occupancy_accounting(fresh_registry):
+    """Satellite pins: (a) group_cap = min(frontend cap, largest bucket)
+    so dispatch never overflows a compiled bucket; (b) padded-vs-real
+    occupancy lands on the registry per dispatch."""
+    from p2p_tpu.obs import get_registry
+    from p2p_tpu.serve import DispatchLoop, InferenceEngine
+    from p2p_tpu.train.state import create_train_state, infer_state_from_train
+
+    cfg = _unet_config()
+    batch = synthetic_batch(2, 32, dtype="uint8")
+    state = infer_state_from_train(
+        create_train_state(cfg, jax.random.key(0), batch, 1))
+    engine = InferenceEngine(cfg, state, buckets=(2,), dtype="f32")
+    reg = get_registry()
+    queue = BoundedRequestQueue(32, registry=reg, tenant="t")
+    img = batch["input"][0]
+    delivered = []
+    loop = DispatchLoop(
+        engine, queue, decode=lambda req: req.payload,
+        deliver=lambda reqs, pred, n: delivered.append((len(reqs), n)),
+        on_poison=lambda req, exc: None,
+        registry=reg, tenant="t", group_cap=16)
+    # a 16-request frontend cap over a (2,)-bucket engine caps groups at 2
+    assert loop.group_cap == 2
+    for i in range(5):
+        queue.offer(f"r{i}", payload=np.asarray(img))
+    assert loop.drain() == 5
+    assert delivered == [(2, 2), (2, 2), (1, 1)]
+    assert engine.n_compiles == 1           # tail never recompiled
+    occ = reg.histogram("serve_batch_occupancy", tenant="t")
+    assert occ.count == 3
+    assert occ.max == 1.0 and abs(occ.min - 0.5) < 1e-9
+    assert reg.counter("serve_padded_images_total", tenant="t").value == 1
+    assert loop.padded_images == 1
+    assert abs(loop.occupancy_mean - (1.0 + 1.0 + 0.5) / 3) < 1e-9
+
+
+# ----------------------------------------------------------- hot-swap
+def _facades_cfg(name="t1"):
+    cfg = get_preset("facades")
+    return dataclasses.replace(
+        cfg, name=name,
+        model=dataclasses.replace(cfg.model, ngf=4),
+        data=dataclasses.replace(cfg.data, dataset="synth", image_size=16))
+
+
+def _save_step(workdir, cfg, step, seed):
+    batch = synthetic_batch(1, 16, dtype="uint8")
+    state = create_train_state(cfg, jax.random.key(seed), batch, 1)
+    d = checkpoint_dir(cfg, workdir)
+    mgr = CheckpointManager(d)
+    mgr.save(step, state, wait=True)
+    mgr.close()
+    return d
+
+
+def _corrupt_manifest(ckpt_dir, step):
+    path = f"{ckpt_dir}.aux/{step}.integrity.json"
+    m = json.load(open(path))
+    leaf = next(iter(m["leaves"]))
+    m["leaves"][leaf]["crc32"] = (m["leaves"][leaf]["crc32"] + 1) % (2**32)
+    json.dump(m, open(path, "w"))
+
+
+def test_hot_swap_changes_weights_with_zero_new_compiles(tmp_path,
+                                                         fresh_registry):
+    cfg = _facades_cfg()
+    d = _save_step(str(tmp_path), cfg, 1, seed=0)
+    tenant = Tenant("m1", cfg, d, buckets=(1, 2), dtype="f32").warmup()
+    imgs = synthetic_batch(2, 16, seed=5, dtype="uint8")
+    before, _, _ = tenant.engine.infer_batch(imgs)
+    before = np.asarray(before, np.float32)
+    compiles = tenant.engine.n_compiles
+
+    _save_step(str(tmp_path), cfg, 2, seed=1)   # different weights
+    out = tenant.reload()
+    assert out["swapped"] and out["step"] == 2 and tenant.step == 2
+    after, _, _ = tenant.engine.infer_batch(imgs)
+    assert tenant.engine.n_compiles == compiles  # zero new compiles
+    assert not np.array_equal(before, np.asarray(after, np.float32))
+    # in-flight semantics: a reference taken before the swap still holds
+    # the OLD weights (the swap is a reference write, not a mutation)
+
+
+def test_hot_swap_rejects_corrupt_manifest_and_keeps_serving(
+        tmp_path, fresh_registry):
+    from p2p_tpu.obs import get_registry
+
+    cfg = _facades_cfg()
+    d = _save_step(str(tmp_path), cfg, 1, seed=0)
+    tenant = Tenant("m1", cfg, d, buckets=(1,), dtype="f32").warmup()
+    imgs = synthetic_batch(1, 16, seed=5, dtype="uint8")
+    before = np.asarray(tenant.engine.infer_batch(imgs)[0], np.float32)
+
+    _save_step(str(tmp_path), cfg, 2, seed=1)
+    _corrupt_manifest(d, 2)
+    with pytest.raises(HotSwapRejected):
+        tenant.reload()
+    assert tenant.step == 1                  # old step still serving...
+    after = np.asarray(tenant.engine.infer_batch(imgs)[0], np.float32)
+    np.testing.assert_array_equal(before, after)   # ...same weights
+    reg = get_registry()
+    assert reg.counter("serve_hot_swap_rejected_total",
+                       tenant="m1").value == 1
+    assert tenant.swap_count == 0
+
+    # a MISSING manifest (copy job died before the sidecar) is the most
+    # likely tear — unverifiable must not read as intact on this path
+    _save_step(str(tmp_path), cfg, 3, seed=2)
+    os.remove(f"{d}.aux/3.integrity.json")
+    with pytest.raises(HotSwapRejected, match="integrity manifest"):
+        tenant.reload(step=3)
+    assert tenant.step == 1
+    assert reg.counter("serve_hot_swap_rejected_total",
+                       tenant="m1").value == 2
+
+
+def test_engine_swap_state_rejects_shape_mismatch(fresh_registry):
+    from p2p_tpu.serve import InferenceEngine
+    from p2p_tpu.train.state import create_train_state, infer_state_from_train
+
+    cfg = _unet_config()
+    batch = synthetic_batch(2, 32, dtype="uint8")
+    state = infer_state_from_train(
+        create_train_state(cfg, jax.random.key(0), batch, 1))
+    engine = InferenceEngine(cfg, state, buckets=(2,), dtype="f32")
+    engine.warmup()
+    other = infer_state_from_train(create_train_state(
+        _unet_config(ngf=16), jax.random.key(0), batch, 1))
+    with pytest.raises(ValueError, match="hot-swap rejected"):
+        engine.swap_state(other)
+    # the good path still works after a rejection
+    engine.swap_state(state)
+    assert engine.n_compiles == 1
+
+
+# ----------------------------------------------------------- HTTP server
+def _png_body(seed=3):
+    img = synthetic_batch(1, 16, seed=seed, dtype="uint8")["input"][0]
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _post(base, path, data, timeout=60):
+    req = urllib.request.Request(base + path, data=data, method="POST")
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, r.read(), r.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+def test_http_server_end_to_end(tmp_path, fresh_registry):
+    """One server, two tenants: translate round-trip + concurrency,
+    the 404/422 ladder, healthz, /metrics exposition, admin hot-swap
+    (accept + corrupt-manifest reject), graceful drain → rc 0."""
+    from p2p_tpu.obs import get_registry
+    from p2p_tpu.resilience import PreemptionGuard
+    from p2p_tpu.serve.server import ServeApp, run_server
+
+    reg = get_registry()
+    cfg1, cfg2 = _facades_cfg("t1"), _facades_cfg("t2")
+    d1 = _save_step(str(tmp_path), cfg1, 1, seed=0)
+    d2 = _save_step(str(tmp_path), cfg2, 1, seed=7)
+    app = ServeApp(registry=reg, io_threads=2, max_queue=32,
+                   linger_ms=5.0, group_cap=2, max_attempts=2,
+                   retry_delay_ms=20.0)
+    app.add_tenant(Tenant("m1", cfg1, d1, registry=reg,
+                          buckets=(1, 2), dtype="f32"))
+    app.add_tenant(Tenant("m2", cfg2, d2, registry=reg,
+                          buckets=(1, 2), dtype="f32"))
+    guard = PreemptionGuard(registry=reg)   # NOT installed: test-driven
+    ready = threading.Event()
+    rc = {}
+    t = threading.Thread(
+        target=lambda: rc.update(v=run_server(
+            app, "127.0.0.1", 0, guard=guard, ready_event=ready)),
+        daemon=True)
+    t.start()
+    assert ready.wait(180), "server never came up"
+    base = f"http://127.0.0.1:{app.httpd.server_address[1]}"
+    body = _png_body()
+
+    # translate round-trip on both tenants, concurrently
+    codes = []
+
+    def hit(alias):
+        st, out, ct = _post(base, f"/v1/{alias}/translate", body)
+        codes.append((alias, st, ct))
+        if st == 200:
+            Image.open(io.BytesIO(out)).verify()
+
+    threads = [threading.Thread(target=hit, args=(a,))
+               for a in ("m1", "m2", "m1", "m2", "m1", "m2")]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120)
+    assert all(st == 200 and ct == "image/png" for _, st, ct in codes), codes
+
+    # the ladder: unknown tenant 404, poison body 422 (after retries)
+    assert _post(base, "/v1/ghost/translate", body)[0] == 404
+    assert _post(base, "/v1/m1/translate", b"not an image")[0] == 422
+
+    # healthz: per-tenant step/buckets/compiles; zero mid-serve recompiles
+    h = json.load(urllib.request.urlopen(base + "/healthz", timeout=10))
+    assert h["status"] == "ok"
+    for alias in ("m1", "m2"):
+        assert h["tenants"][alias]["step"] == 1
+        assert h["tenants"][alias]["n_compiles"] == 2  # == len(buckets)
+
+    # admin hot-swap: accept a good step...
+    _save_step(str(tmp_path), cfg1, 2, seed=1)
+    st, out, _ = _post(base, "/admin/reload",
+                       json.dumps({"tenant": "m1"}).encode())
+    assert st == 200 and json.loads(out)["step"] == 2
+    assert _post(base, "/v1/m1/translate", body)[0] == 200
+    # ...reject a corrupt one, old weights keep serving
+    _save_step(str(tmp_path), cfg1, 3, seed=2)
+    _corrupt_manifest(d1, 3)
+    st, out, _ = _post(base, "/admin/reload",
+                       json.dumps({"tenant": "m1", "step": 3}).encode())
+    assert st == 409 and json.loads(out)["swapped"] is False
+    assert _post(base, "/v1/m1/translate", body)[0] == 200
+    h = json.load(urllib.request.urlopen(base + "/healthz", timeout=10))
+    assert h["tenants"]["m1"]["step"] == 2
+    assert h["tenants"]["m1"]["n_compiles"] == 2   # swap compiled nothing
+
+    # live /metrics: the SLO series exist, tenant-tagged
+    mtext = urllib.request.urlopen(base + "/metrics", timeout=10
+                                   ).read().decode()
+    for needle in ("serve_request_latency_seconds", "serve_queue_depth",
+                   "serve_batch_occupancy", "serve_shed_total",
+                   'tenant="m1"', 'tenant="m2"'):
+        assert needle in mtext, f"missing {needle} in /metrics"
+
+    # graceful drain: programmatic preemption → rc 0, summaries recorded
+    guard.request()
+    t.join(60)
+    assert rc.get("v") == 0
+    summaries = {s["tenant"]: s for s in app.summaries()}
+    assert summaries["m1"]["served"] >= 5
+    assert summaries["m1"]["n_compiles"] == 2
+    assert summaries["m1"]["hot_swaps"] == 1
+    assert summaries["m1"]["quarantined"] == 1     # the poison body
