@@ -779,6 +779,26 @@ def run_sweep(dry_run: bool = False) -> int:
     if saved:
         print(f"note: ignoring {sorted(saved)} for --sweep",
               file=sys.stderr)
+    from p2p_tpu.analysis.hlo_cost import roofline_row_for
+
+    def sweep_roofline(row):
+        """The perf_budget.json row statically modeling this sweep row's
+        program, None when the traced set doesn't cover it. Keys on the
+        FULL row env, not just the preset: BENCH_INT8 switches the U-Net
+        family to the delayed-int8 program, and the plain cityscapes row
+        runs the reference norm — only its BENCH_NORM=pallas_instance
+        variant matches the fused traced row."""
+        if row.get("mode") == "serve":
+            return None          # the traced set models train/eval steps
+        env = row["env"]
+        preset = env.get("BENCH_PRESET", "facades_int8")
+        if env.get("BENCH_INT8"):
+            return (roofline_row_for("facades_int8")
+                    if preset in ("facades", "edges2shoes_dp") else None)
+        if preset == "cityscapes_spatial" and not env.get("BENCH_NORM"):
+            return None          # reference-norm program, not the fused one
+        return roofline_row_for(preset)
+
     regressions = []
     results = []
     try:
@@ -805,7 +825,11 @@ def run_sweep(dry_run: bool = False) -> int:
                     regressions.append((row["name"], rec["value"], lo))
             entry = {"row": row["name"], "value": rec["value"],
                      "band": list(band) if band is not None else None,
-                     "status": status, "metric": rec["metric"]}
+                     "status": status, "metric": rec["metric"],
+                     # the perf_budget.json row statically modeling this
+                     # config's program family (ISSUE 13): the measured
+                     # number and its cost-model bound travel together
+                     "roofline": sweep_roofline(row)}
             if "p50_ms" in rec:
                 # the serving row's latency tail rides the sweep record
                 entry["latency_ms"] = {"p50": rec["p50_ms"],

@@ -1,6 +1,6 @@
-"""Static-analysis subsystem — the standing correctness gate.
+"""Static-analysis subsystem — the standing correctness+performance gate.
 
-Six analyzers over one structured-findings format
+Eight analyzers over one structured-findings format
 (:mod:`p2p_tpu.analysis.findings`; waivable in-source via
 ``# p2p-lint: disable=<rule> -- reason``):
 
@@ -29,6 +29,14 @@ Six analyzers over one structured-findings format
 - :mod:`p2p_tpu.analysis.ast_rules` — project AST lints over ``p2p_tpu/``
   (traced randomness, ``jax.debug`` outside obs, hot-loop host syncs,
   CLI↔config flag drift).
+- :mod:`p2p_tpu.analysis.hlo_cost` — the static roofline cost model:
+  per-program FLOPs / bytes-moved / arithmetic intensity over the traced
+  set, published as the ``perf_budget.json`` artifact with canonical-row
+  bounds asserted.
+- :mod:`p2p_tpu.analysis.perf_audit` — performance lints: the fusion-gap
+  lint (``perf-unfused-norm-chain``), the collective-overlap audit
+  (``perf-serialized-collective``), and the delayed-int8 coverage
+  worklist (``--int8-diff``, ROADMAP item 2).
 
 Frontend: ``python -m p2p_tpu.cli.lint --strict`` (the CI gate) —
 docs/STATIC_ANALYSIS.md has the rule catalog and waiver policy. Every

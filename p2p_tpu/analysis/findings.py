@@ -41,6 +41,16 @@ PRAGMA_RE = re.compile(
 RULE_BAD_WAIVER = "lint-waiver-without-reason"
 
 
+def waiver_summary_line(n_waived: int) -> str:
+    """The ONE formatter for the waiver-count summary — the same pattern
+    as ``obs.prometheus_exposition`` (one formatter behind every scrape
+    surface): the lint CLI's OK and FAIL status lines both embed this
+    string, so the phrase CI greps (``waiver(s) carried with reasons``)
+    appears EXACTLY once per run regardless of outcome, and the two
+    print paths cannot drift apart."""
+    return f"{int(n_waived)} waiver(s) carried with reasons"
+
+
 @dataclasses.dataclass
 class Finding:
     rule: str

@@ -1,0 +1,308 @@
+"""Performance lints over traced programs (ISSUE 13 tentpole, parts b-d).
+
+Three rules, all structural walks of jaxprs traced from
+``ShapeDtypeStruct`` args (zero device compute), all following the
+findings/waiver conventions of docs/STATIC_ANALYSIS.md:
+
+- **Fusion-gap lint** (``perf-unfused-norm-chain``,
+  :func:`unfused_norm_chain_findings`): in a program whose config says
+  the InstanceNorm+activation(+residual) epilogues fuse through
+  ``ops/pallas/norm_act`` (``norm="pallas_instance"``), any REFERENCE
+  instance-norm chain — the ``rsqrt`` over per-(sample,channel) stat
+  tiles multiplied back into the full activation — is a chain that did
+  NOT reach the kernel: either the dispatch seam silently fell back to
+  the lax reference on TPU, or new model code never routed through
+  ``ops/norm.make_norm_act``. The walk does not descend into
+  ``pallas_call`` bodies (the kernel's interior rsqrt is the FUSED
+  path), so the detector is purely structural and backend-independent;
+  the lint CLI traces the fused program with ``P2P_TPU_FORCE_PALLAS=1``
+  so the kernel appears in the jaxpr even on a CPU runner. Findings
+  carry the chain's ``file:line`` via jax source info — a deliberate
+  reference island waives in source.
+
+- **Collective-overlap audit** (``perf-serialized-collective``,
+  :func:`serialized_collective_findings`): the schedule rule
+  generalizing ``jaxpr_lint.scan_ppermute_carry_flags`` into a finding:
+  every in-``scan`` collective's operand is classified *carried/invar*
+  (available when the tick starts — the transfer can run under the
+  tick's compute, the latency-hiding property ``pp_overlap`` buys) vs
+  *tick-computed* (produced by the tick body — the ICI hop serializes
+  behind stage compute). Tick-computed operands flag at warning
+  severity naming the overlap lever.
+
+- **int8-coverage worklist** (``perf-int8-coverage-gap``,
+  :func:`int8_coverage`): in a program whose config enables the
+  delayed-int8 path, every ``conv_general_dilated`` / ``dot_general``
+  still contracting in bf16/f32 is unconverted MXU work — today the
+  D-side beyond what ISSUE 6 quantized, the C network, and the
+  deliberately-bf16 stems/heads. Info severity (the ROADMAP item-2
+  twin of the item-3 tp-diff worklist: CLI ``--int8-diff`` prints it,
+  CI asserts it NON-empty until the quantization lever drains it),
+  deduped per source line like ``jaxpr-f32-leak``.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Tuple
+
+from p2p_tpu.analysis.findings import INFO, WARNING, Finding
+from p2p_tpu.analysis.jaxpr_lint import (
+    COLLECTIVE_PRIMITIVES,
+    eqn_location,
+    normalize_primitive,
+    sub_jaxprs,
+)
+
+RULE_UNFUSED_NORM = "perf-unfused-norm-chain"
+RULE_SERIALIZED = "perf-serialized-collective"
+RULE_INT8_GAP = "perf-int8-coverage-gap"
+
+#: elementwise-ish links a norm chain may pass through between the
+#: stat-rsqrt and the full-size multiply
+_CHAIN_LINKS = frozenset({
+    "mul", "add", "sub", "convert_element_type", "broadcast_in_dim",
+    "reshape", "max", "min",
+})
+
+
+from p2p_tpu.analysis.hlo_cost import _aval_numel as _numel
+
+
+def _is_stat_shaped(v) -> bool:
+    """The instance-norm statistic signature: rank >= 3 with the spatial
+    dims reduced to 1 (``(N, 1, 1, C)`` after a keepdims mean/var over
+    H, W). BatchNorm stats are rank-1 ``(C,)`` and never match — the
+    rule is specifically about the per-sample norm the Pallas kernel
+    fuses."""
+    shape = getattr(getattr(v, "aval", None), "shape", None)
+    if shape is None or len(shape) < 3:
+        return False
+    unit = sum(1 for d in shape[1:-1] if d == 1)
+    return unit >= 1 and unit == len(shape) - 2
+
+
+def _feeds_full_multiply(start_var, consumers, depth: int = 6) -> bool:
+    """True when ``start_var`` (a stat-shaped tensor) reaches, through a
+    short elementwise chain, a ``mul`` against a tensor with strictly
+    more elements — the normalize step applying rsqrt(var) to the full
+    activation."""
+    seen = set()
+    frontier = [(start_var, 0)]
+    while frontier:
+        v, d = frontier.pop()
+        if d > depth or id(v) in seen:
+            continue
+        seen.add(id(v))
+        for eqn in consumers.get(id(v), ()):
+            name = eqn.primitive.name
+            if name == "mul":
+                others = [o for o in eqn.invars if id(o) != id(v)]
+                if any(_numel(o) > max(1, _numel(v)) * 3 for o in others):
+                    return True
+            if name in _CHAIN_LINKS:
+                for ov in eqn.outvars:
+                    frontier.append((ov, d + 1))
+    return False
+
+
+def unfused_norm_chain_findings(jaxpr, tag: str = "program",
+                                ) -> List[Finding]:
+    """Findings for reference instance-norm(+act) chains in a program
+    that was supposed to route them through ``ops/pallas/norm_act``.
+    One finding per source line (a model reuses the same norm call site
+    across blocks/microbatches — one policy decision, one finding)."""
+    seen: Dict[Tuple, Finding] = {}
+    counts: Dict[Tuple, int] = defaultdict(int)
+
+    def scan(jx):
+        if hasattr(jx, "jaxpr"):
+            jx = jx.jaxpr
+        consumers: Dict[int, List] = defaultdict(list)
+        for eqn in jx.eqns:
+            for v in eqn.invars:
+                if type(v).__name__ == "Var":
+                    consumers[id(v)].append(eqn)
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                continue          # the kernel interior IS the fused path
+            if eqn.primitive.name == "rsqrt" \
+                    and _is_stat_shaped(eqn.outvars[0]) \
+                    and _feeds_full_multiply(eqn.outvars[0], consumers):
+                fname, line = eqn_location(eqn)
+                key = (fname, line)
+                counts[key] += 1
+                if key not in seen:
+                    seen[key] = Finding(
+                        rule=RULE_UNFUSED_NORM, severity=WARNING,
+                        file=fname, line=line,
+                        path=None if fname else tag,
+                        message=f"InstanceNorm(+act) chain in {tag!r} "
+                                "lowered as reference XLA ops instead of "
+                                "the fused ops/pallas/norm_act kernel — "
+                                "silent fallback of the dispatch seam, or "
+                                "model code not routed through "
+                                "ops/norm.make_norm_act",
+                    )
+                continue
+            for sub in sub_jaxprs(eqn.params):
+                scan(sub)
+
+    scan(jaxpr)
+    out = []
+    for key, f in seen.items():
+        if counts[key] > 1:
+            f.message += f" (x{counts[key]} chains at this line)"
+        out.append(f)
+    return out
+
+
+# ---------------------------------------------- collective overlap (c)
+
+
+def classify_scan_collectives(jaxpr, kinds: Iterable[str] = ("ppermute",),
+                              ) -> List[Dict[str, Any]]:
+    """For every collective of ``kinds`` directly inside a ``lax.scan``
+    body: ``{"kind", "operand": "carry"|"invar"|"computed", "eqn"}``.
+
+    - ``carry``    — a scan carry invar: the previous tick's value; the
+      transfer is structurally independent of this tick's compute (the
+      overlapped schedule's pin).
+    - ``invar``    — a body const/xs invar: available when the tick
+      starts; the transfer can still issue ahead of compute.
+    - ``computed`` — produced by the tick body before the collective:
+      the ICI hop cannot start until that compute finishes — serialized.
+    """
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    kinds = {normalize_primitive(k) for k in kinds}
+    out: List[Dict[str, Any]] = []
+
+    def classify_body(jx, env):
+        """Classify collectives of a scan body against ``env`` (var id →
+        carry/invar), following them INTO wrapper sub-jaxprs (remat,
+        pjit, custom_vjp) whose invars align positionally with the
+        wrapping eqn's — a checkpointed stage function must not hide a
+        serialized hop from the audit. Unalignable wrappers are skipped
+        (no classification beats a false positive); inner scans get
+        their own context from the outer walk."""
+        for eqn in jx.eqns:
+            name = normalize_primitive(eqn.primitive.name)
+            if name in kinds and name in COLLECTIVE_PRIMITIVES:
+                op = eqn.invars[0]
+                out.append({"kind": name,
+                            "operand": env.get(id(op), "computed"),
+                            "eqn": eqn})
+                continue
+            if eqn.primitive.name == "scan":
+                continue
+            for sub in sub_jaxprs(eqn.params):
+                sj = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                if len(sj.invars) != len(eqn.invars):
+                    continue
+                inner = {id(iv): env[id(ov)]
+                         for iv, ov in zip(sj.invars, eqn.invars)
+                         if id(ov) in env}
+                classify_body(sj, inner)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+                env = {}
+                for i, v in enumerate(body.invars):
+                    env[id(v)] = ("carry" if nc <= i < nc + nk
+                                  else "invar")
+                for v in getattr(body, "constvars", ()):
+                    env[id(v)] = "invar"   # loop-invariant closure
+                classify_body(body, env)
+                walk(body)
+            else:
+                for sub in sub_jaxprs(eqn.params):
+                    walk(sub)
+
+    walk(jaxpr)
+    return out
+
+
+def serialized_collective_findings(jaxpr, tag: str = "program",
+                                   kinds: Iterable[str] = ("ppermute",),
+                                   ) -> List[Finding]:
+    """``perf-serialized-collective`` findings for every tick-computed
+    in-scan collective operand (see :func:`classify_scan_collectives`)."""
+    out: List[Finding] = []
+    for rec in classify_scan_collectives(jaxpr, kinds=kinds):
+        if rec["operand"] != "computed":
+            continue
+        fname, line = eqn_location(rec["eqn"])
+        out.append(Finding(
+            rule=RULE_SERIALIZED, severity=WARNING,
+            file=fname, line=line, path=None if fname else tag,
+            message=f"in-scan {rec['kind']} in {tag!r} consumes a value "
+                    "computed by the SAME tick — the ICI hop serializes "
+                    "behind stage compute; route the previous tick's "
+                    "output through the scan carry instead "
+                    "(ParallelConfig.pp_overlap / --pp_overlap, "
+                    "docs/PARALLELISM.md latency-hiding schedule)",
+        ))
+    return out
+
+
+# ------------------------------------------------- int8 coverage (d)
+
+
+def int8_coverage(jaxpr, tag: str = "program",
+                  ) -> Tuple[List[dict], List[Finding]]:
+    """``(worklist, findings)`` enumerating conv/dot eqns still
+    contracting in bf16/f32 inside a delayed-int8 program. Info severity
+    — the migration worklist ROADMAP item 2's quantization lever drains,
+    mirroring ``--tp-diff``: entries carry op, operand dtypes, shapes and
+    ``file:line``; one entry per source line with an eqn count."""
+    agg: Dict[Tuple, dict] = {}
+    # descend everything EXCEPT pallas_call kernels (block-shaped refs)
+    def walk(jx):
+        if hasattr(jx, "jaxpr"):
+            jx = jx.jaxpr
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                continue
+            if eqn.primitive.name in ("conv_general_dilated",
+                                      "dot_general"):
+                dts = tuple(
+                    str(getattr(getattr(v, "aval", None), "dtype", "?"))
+                    for v in eqn.invars[:2])
+                # covered = BOTH contraction operands int8 (the s8×s8→s32
+                # MXU path — the same law hlo_cost._mxu_dtype_key books
+                # the doubled rate under); a half-quantized site is
+                # still unconverted MXU work and stays on the worklist
+                if all(d == "int8" for d in dts):
+                    continue
+                fname, line = eqn_location(eqn)
+                key = (fname, line, eqn.primitive.name, dts)
+                if key in agg:
+                    agg[key]["eqns"] += 1
+                else:
+                    agg[key] = {
+                        "program": tag,
+                        "op": eqn.primitive.name,
+                        "dtypes": list(dts),
+                        "out_shape": list(getattr(
+                            eqn.outvars[0].aval, "shape", ())),
+                        "file": fname, "line": line, "eqns": 1,
+                    }
+                continue
+            for sub in sub_jaxprs(eqn.params):
+                walk(sub)
+
+    walk(jaxpr)
+    worklist = list(agg.values())
+    findings = [Finding(
+        rule=RULE_INT8_GAP, severity=INFO,
+        file=w["file"], line=w["line"], path=None if w["file"] else tag,
+        message=f"{w['op']} still contracts in {tuple(w['dtypes'])} in "
+                f"delayed-int8 program {tag!r} (out {tuple(w['out_shape'])}"
+                f", x{w['eqns']} eqns) — unconverted MXU work for the "
+                "ROADMAP item-2 int8 lever",
+    ) for w in worklist]
+    return worklist, findings

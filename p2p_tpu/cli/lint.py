@@ -1,7 +1,8 @@
 """Static-analysis frontend — ``python -m p2p_tpu.cli.lint --strict``.
 
-The standing CI correctness gate (docs/STATIC_ANALYSIS.md). Six analyzers
-share one findings format and fail the gate on any unwaived finding:
+The standing CI correctness+performance gate (docs/STATIC_ANALYSIS.md).
+Eight analyzers share one findings format and fail the gate on any
+unwaived finding:
 
 1. **AST rules** over every module of ``p2p_tpu/`` (traced randomness,
    ``jax.debug`` outside obs, hot-loop host syncs, CLI↔config flag drift).
@@ -29,11 +30,25 @@ share one findings format and fail the gate on any unwaived finding:
    devices) the pipelined ``build_pp_train_step`` program — walked for
    host callbacks, f32 dot/conv leaks under the declared bf16 policy,
    and collectives under ``lax.cond``.
+7. **Roofline cost model** (analysis/hlo_cost): per-program FLOPs /
+   bytes-moved / arithmetic-intensity over the traced set, published as
+   the ``perf_budget.json`` artifact via ``--perf-budget PATH``
+   (``memory_budget.json``'s twin) with canonical-row bounds asserted
+   (``perf-roofline-out-of-bounds``).
+8. **Performance audit** (analysis/perf_audit): the fusion-gap lint
+   (``perf-unfused-norm-chain`` over a ``P2P_TPU_FORCE_PALLAS``-traced
+   fused program), the collective-overlap audit
+   (``perf-serialized-collective`` over the overlap-scheduled PP
+   program), and the delayed-int8 coverage worklist (``--int8-diff``,
+   mirroring ``--tp-diff`` — info severity, CI asserts it non-empty
+   until ROADMAP item 2's quantization lever drains it).
 
 Waivers: ``# p2p-lint: disable=<rule> -- reason`` in source (findings
 carry eqn source locations, so even jaxpr findings waive in-source); the
-waiver COUNT is printed in the summary — CI logs it on every run, and
-tests pin a ceiling so it can only go down.
+waiver COUNT is printed via the ONE shared formatter
+(``findings.waiver_summary_line`` — exactly once per run, on the OK and
+FAIL paths alike; CI greps the phrase) and tests pin a ceiling so it can
+only go down.
 
 Exit codes: 0 clean (waived-only), 1 unwaived findings, 2 analyzer crash.
 """
@@ -71,6 +86,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also print the sharding auditor's tp-vs-rule-"
                         "table migration worklist (ROADMAP item 3), one "
                         "line per leaf")
+    p.add_argument("--int8-diff", action="store_true", dest="int8_diff",
+                   help="also print the delayed-int8 coverage worklist "
+                        "(ROADMAP item 2): every conv/dot still "
+                        "contracting in bf16/f32 inside the int8 traced "
+                        "programs, one line per source site")
+    p.add_argument("--perf-budget", type=str, default=None,
+                   dest="perf_budget", metavar="PATH",
+                   help="ALSO write the static roofline table "
+                        "(per-program FLOPs / bytes / arithmetic "
+                        "intensity over the traced set) to PATH as JSON "
+                        "— the CI artifact; canonical rows outside their "
+                        "declared bands join the report as warnings")
     p.add_argument("--skip-jaxpr", action="store_true",
                    help="skip the (slower) traced-program analyses — "
                         "jaxpr walks AND the donation audit; AST + "
@@ -121,12 +148,12 @@ def _tiny_batch(cfg, frames: int = 0):
     }
 
 
-#: the sharding-audit preset set: the facades family audits (and diffs)
-#: against its predicate-rule TP table — zero gaps is the drained state —
-#: while the ResNet family still diffs against REPLICATED_RULES, feeding
-#: the item-3 worklist.
+#: the sharding-audit preset set: every family audits (and diffs)
+#: against its predicate-rule TP table — zero gaps everywhere is the
+#: drained state (ISSUE 13 closed the ResNet/pix2pixHD families; the
+#: empty worklist is CI-asserted so a drained family cannot regress).
 AUDIT_PRESETS = ("facades", "facades_int8", "edges2shoes_dp",
-                 "cityscapes_spatial")
+                 "cityscapes_spatial", "pix2pixhd", "reference")
 
 
 def run_sharding_audit(report, tp_axis_size: int, tp_min_ch: int):
@@ -226,10 +253,12 @@ def run_memory_audit(report, budget_path=None):
               f"{budget_path}", file=sys.stderr)
 
 
-def _pp_program():
+def _pp_program(overlap: bool = False):
     """The pipelined train step's jaxpr on a tiny 2-stage mesh, or None
     when fewer than 2 devices are visible (the CLI forces 8 fake CPU
-    devices when it owns jax initialization)."""
+    devices when it owns jax initialization). ``overlap=True`` traces the
+    latency-hiding schedule — the variant the collective-overlap audit
+    and the roofline table pin."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -242,6 +271,9 @@ def _pp_program():
     from p2p_tpu.train.step import build_pp_train_step
 
     cfg = _tiny_cfg("reference", n_blocks=4)
+    cfg = dataclasses.replace(
+        cfg, parallel=dataclasses.replace(cfg.parallel,
+                                          pp_overlap=overlap))
     bs, (h, w) = cfg.data.batch_size, cfg.image_hw
     sample = {
         "input": np.zeros((bs, h, w, cfg.model.input_nc), np.uint8),
@@ -260,12 +292,14 @@ def _pp_program():
     return jax.make_jaxpr(step)(_sds_tree(pp_state), batch)
 
 
-def run_traced_analyses(report):
+def run_traced_analyses(report, programs=None):
     """The traced-program analyses: jaxpr walks (host callbacks, f32
     leaks under the declared bf16 policy, collectives under ``lax.cond``)
     AND the donation-marker audit — each train-step program is traced
     ONCE (``jit(...).trace``) and both the jaxpr and the lowering come
-    from that single trace."""
+    from that single trace. ``programs`` (a dict) collects the traced
+    jaxprs by row name so the perf analyses / roofline table reuse them
+    instead of re-tracing."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -283,6 +317,7 @@ def run_traced_analyses(report):
     from p2p_tpu.train.step import build_train_step, make_infer_forward
 
     findings = []
+    programs = {} if programs is None else programs
 
     def walk(jx, tag, allow=()):
         findings.extend(host_callback_findings(jx, tag=tag, allow=allow))
@@ -296,13 +331,16 @@ def run_traced_analyses(report):
     # are the known, pragma-waived island in losses/metrics.py)
     ist = jax.eval_shape(lambda: create_infer_state(
         cfg, jax.random.key(0), sample, jnp.bfloat16))
-    walk(jax.make_jaxpr(make_infer_forward(cfg, jnp.bfloat16))(
-        _sds_tree(ist), batch), tag="eval_forward")
+    jx_eval = jax.make_jaxpr(make_infer_forward(cfg, jnp.bfloat16))(
+        _sds_tree(ist), batch)
+    programs["eval_forward[facades]"] = jx_eval
+    walk(jx_eval, tag="eval_forward")
 
     # the full alternating-GAN train step (debug taps at their defaults:
     # a host callback here would fence every training dispatch) — ONE
     # trace of the jitted, donating step serves walks AND donation audit
     tr = build_train_step(cfg, train_dtype=jnp.bfloat16).trace(sds, batch)
+    programs["train_step[facades]"] = tr.jaxpr
     walk(tr.jaxpr, tag="train_step")
     report.extend(donation_findings(tr.lower().as_text(), sds,
                                     tag="train_step", jaxpr=tr.jaxpr))
@@ -324,6 +362,7 @@ def run_traced_analyses(report):
     vcfg, vsds, vbatch = _video_setup()
     vtr = build_video_train_step(
         vcfg, train_dtype=jnp.bfloat16).trace(vsds, vbatch)
+    programs["video_train_step[vid2vid_temporal]"] = vtr.jaxpr
     walk(vtr.jaxpr, tag="video_train_step")
     report.extend(donation_findings(vtr.lower().as_text(), vsds,
                                     tag="video_train_step",
@@ -339,6 +378,149 @@ def run_traced_analyses(report):
               file=sys.stderr)
 
     report.extend(apply_pragma_waivers(findings))
+
+
+def _int8_train_program():
+    """The delayed-int8 GAN train step's jaxpr (tiny facades_int8) —
+    the program the int8-coverage worklist enumerates."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = _tiny_cfg("facades_int8")
+    batch = _tiny_batch(cfg)
+    sds = _sds_tree(jax.eval_shape(lambda: create_train_state(
+        cfg, jax.random.key(0),
+        {k: np.zeros(v.shape, v.dtype) for k, v in batch.items()},
+        train_dtype=jnp.bfloat16)))
+    return jax.make_jaxpr(build_train_step(
+        cfg, train_dtype=jnp.bfloat16, jit=False))(sds, batch)
+
+
+def _fused_train_program():
+    """The pallas-fused train step's jaxpr: a tiny cityscapes config with
+    ``norm=norm_d="pallas_instance"``, traced under
+    ``P2P_TPU_FORCE_PALLAS=1`` so the dispatch seam routes to the REAL
+    kernel even on a CPU runner — the fusion-gap lint then proves no
+    chain silently fell back to the lax reference."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.train.state import create_train_state
+    from p2p_tpu.train.step import build_train_step
+
+    cfg = _tiny_cfg("cityscapes_spatial", norm="pallas_instance",
+                    norm_d="pallas_instance")
+    batch = _tiny_batch(cfg)
+    sds = _sds_tree(jax.eval_shape(lambda: create_train_state(
+        cfg, jax.random.key(0),
+        {k: np.zeros(v.shape, v.dtype) for k, v in batch.items()},
+        train_dtype=jnp.bfloat16)))
+    old = os.environ.get("P2P_TPU_FORCE_PALLAS")
+    os.environ["P2P_TPU_FORCE_PALLAS"] = "1"
+    try:
+        return jax.make_jaxpr(build_train_step(
+            cfg, train_dtype=jnp.bfloat16, jit=False))(sds, batch)
+    finally:
+        if old is None:
+            os.environ.pop("P2P_TPU_FORCE_PALLAS", None)
+        else:
+            os.environ["P2P_TPU_FORCE_PALLAS"] = old
+
+
+def _ensure_perf_programs(programs):
+    """Add the perf traced programs (int8 train step, forced-pallas
+    fused step, overlap-scheduled PP step — plus the base eval/train/
+    video programs when the jaxpr stage didn't already stash them, so
+    ``--skip-jaxpr --perf-budget`` still writes the COMPLETE table) to
+    ``programs``, tracing each at most once per run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if "eval_forward[facades]" not in programs \
+            or "train_step[facades]" not in programs:
+        from p2p_tpu.train.state import create_infer_state
+        from p2p_tpu.train.step import build_train_step, make_infer_forward
+
+        cfg, sds, batch = _image_setup()
+        sample = {k: np.zeros(v.shape, v.dtype) for k, v in batch.items()}
+        ist = jax.eval_shape(lambda: create_infer_state(
+            cfg, jax.random.key(0), sample, jnp.bfloat16))
+        programs["eval_forward[facades]"] = jax.make_jaxpr(
+            make_infer_forward(cfg, jnp.bfloat16))(_sds_tree(ist), batch)
+        programs["train_step[facades]"] = jax.make_jaxpr(build_train_step(
+            cfg, train_dtype=jnp.bfloat16, jit=False))(sds, batch)
+    if "video_train_step[vid2vid_temporal]" not in programs:
+        from p2p_tpu.train.video_step import build_video_train_step
+
+        vcfg, vsds, vbatch = _video_setup()
+        programs["video_train_step[vid2vid_temporal]"] = jax.make_jaxpr(
+            build_video_train_step(vcfg, train_dtype=jnp.bfloat16,
+                                   jit=False))(vsds, vbatch)
+    if "train_step[facades_int8]" not in programs:
+        programs["train_step[facades_int8]"] = _int8_train_program()
+    if "train_step[cityscapes_pallas]" not in programs:
+        programs["train_step[cityscapes_pallas]"] = _fused_train_program()
+    if "pp_train_step[reference]" not in programs:
+        pp = _pp_program(overlap=True)
+        if pp is not None:
+            programs["pp_train_step[reference]"] = pp
+        else:
+            print("lint: skipping pp_train_step perf trace (<2 devices)",
+                  file=sys.stderr)
+    return programs
+
+
+def run_perf_analyses(report, programs):
+    """Analyzer 8 (analysis/perf_audit): the fusion-gap lint over the
+    forced-pallas fused program, the collective-overlap audit over the
+    overlap-scheduled PP program, and the delayed-int8 coverage worklist.
+    Returns the worklist for ``--int8-diff``."""
+    from p2p_tpu.analysis.findings import apply_pragma_waivers
+    from p2p_tpu.analysis.perf_audit import (
+        int8_coverage,
+        serialized_collective_findings,
+        unfused_norm_chain_findings,
+    )
+
+    _ensure_perf_programs(programs)
+    findings = []
+    findings.extend(unfused_norm_chain_findings(
+        programs["train_step[cityscapes_pallas]"],
+        tag="train_step[cityscapes_pallas]"))
+    pp = programs.get("pp_train_step[reference]")
+    if pp is not None:
+        findings.extend(serialized_collective_findings(
+            pp, tag="pp_train_step[reference]"))
+    worklist, info = int8_coverage(
+        programs["train_step[facades_int8]"],
+        tag="train_step[facades_int8]")
+    report.extend(apply_pragma_waivers(findings))
+    report.extend(info)
+    return worklist
+
+
+def run_perf_budget(report, programs, budget_path):
+    """Analyzer 7 (analysis/hlo_cost): the static roofline table over
+    every traced program, written as the ``perf_budget.json`` artifact
+    (``memory_budget.json``'s twin); canonical rows outside their
+    declared bands join the report as warnings."""
+    import json
+
+    from p2p_tpu.analysis.hlo_cost import CHIP_MODEL, perf_budget_rows
+
+    _ensure_perf_programs(programs)
+    rows, findings = perf_budget_rows(sorted(programs.items()))
+    report.extend(findings)
+    with open(budget_path, "w") as fh:
+        json.dump({"chip": CHIP_MODEL, "rows": rows}, fh, indent=2)
+    print(f"perf budget table: {len(rows)} roofline rows -> "
+          f"{budget_path}", file=sys.stderr)
 
 
 def run_ast_passes(report):
@@ -381,12 +563,17 @@ def main(argv=None) -> int:
 
     try:
         report = Report()
+        programs = {}   # traced jaxprs by row name, shared across stages
         run_ast_passes(report)
         worklist = run_sharding_audit(report, args.tp_axis_size,
                                       args.tp_min_ch)
         run_memory_audit(report, budget_path=args.memory_budget)
+        int8_worklist = []
         if not args.skip_jaxpr:
-            run_traced_analyses(report)
+            run_traced_analyses(report, programs=programs)
+            int8_worklist = run_perf_analyses(report, programs)
+        if args.perf_budget:
+            run_perf_budget(report, programs, args.perf_budget)
     except Exception:
         traceback.print_exc()
         print("lint: analyzer crashed (exit 2)", file=sys.stderr)
@@ -400,6 +587,8 @@ def main(argv=None) -> int:
             # the machine-readable form of the item-3 worklist — the text
             # branch's per-leaf lines, with shapes/specs as fields
             payload["tp_worklist"] = worklist
+        if args.int8_diff:
+            payload["int8_worklist"] = int8_worklist
         print(json.dumps(payload, indent=2))
     else:
         print(report.render())
@@ -410,18 +599,33 @@ def main(argv=None) -> int:
                 print(f"  [{entry['preset']}] {entry['leaf']} "
                       f"shape={entry['shape']} tp={entry['tp_spec']} "
                       f"table={entry['rule_spec']} ({entry['direction']})")
+        if args.int8_diff:
+            print(f"\nint8-coverage worklist ({len(int8_worklist)} "
+                  "conv/dot sites still contract in bf16/f32 under "
+                  "delayed-int8 — ROADMAP item 2):")
+            for w in int8_worklist:
+                loc = f"{w['file']}:{w['line']}" if w["file"] else "<?>"
+                print(f"  [{w['program']}] {w['op']} "
+                      f"{tuple(w['dtypes'])} out={tuple(w['out_shape'])} "
+                      f"{loc} x{w['eqns']}")
     failing = report.failing(strict=args.strict)
-    waived = len(report.waived)
+    from p2p_tpu.analysis.findings import waiver_summary_line
+
+    # the ONE waiver-count line (findings.waiver_summary_line — the
+    # prometheus_exposition pattern: one formatter, every surface), so
+    # the CI grep sees it EXACTLY once per run, pass or fail
+    waivers = waiver_summary_line(len(report.waived))
     mode = "strict" if args.strict else "default"
     # json mode keeps stdout machine-parseable: the status line goes to
     # stderr there, stdout in text mode (the CI log greps it)
     status_stream = sys.stderr if args.format == "json" else sys.stdout
     if failing:
-        print(f"lint: FAIL ({mode}) — {len(failing)} unwaived finding(s), "
-              f"{waived} waiver(s)", file=sys.stderr)
+        print(f"lint: FAIL ({mode}) — {len(failing)} unwaived "
+              f"finding(s), {waivers}", file=status_stream)
         return 1
-    print(f"lint: OK ({mode}) — 0 unwaived findings, {waived} waiver(s) "
-          f"carried with reasons, tp worklist {len(worklist)} leaves",
+    print(f"lint: OK ({mode}) — 0 unwaived findings, {waivers}, "
+          f"tp worklist {len(worklist)} leaves, int8 worklist "
+          f"{len(int8_worklist)} sites",
           file=status_stream)
     return 0
 

@@ -117,6 +117,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain_timeout", type=float, default=30.0,
                    help="HTTP mode: max seconds after SIGTERM to run the "
                         "queues down before stragglers are answered 503")
+    p.add_argument("--tenant_quota", type=int, default=None,
+                   help="HTTP mode: max in-flight requests PER TENANT "
+                        "(admitted, not yet answered); arrivals beyond "
+                        "it get 429 + serve_quota_rejected_total — the "
+                        "fairness cap so one tenant's burst cannot "
+                        "starve the other tenants' queue slots "
+                        "(default: unlimited)")
     # --- resilience knobs (docs/RESILIENCE.md) ---------------------------
     p.add_argument("--max_queue", type=int, default=512,
                    help="request queue depth cap; overflow arrivals are "
@@ -238,7 +245,8 @@ def _serve_http(args, buckets) -> int:
         max_queue=args.max_queue, deadline_ms=args.deadline_ms,
         linger_ms=args.linger_ms, group_cap=args.max_batch,
         max_attempts=args.max_attempts,
-        retry_delay_ms=args.retry_delay_ms)
+        retry_delay_ms=args.retry_delay_ms,
+        tenant_quota=args.tenant_quota)
     try:
         for alias, ov in specs:
             cfg = _build_config(args, ov)

@@ -35,7 +35,16 @@ def _xla_instance_norm(x, scale, bias, eps):
 def _xla_instance_norm_act(x, scale, bias, residual, act, slope, eps):
     """The lax reference for the fused epilogue — the CPU/tier-1 fallback
     of :func:`pallas_instance_norm_act` (same op order as the kernel:
-    norm → affine → residual add → activation, all in f32)."""
+    norm → affine → residual add → activation, all in f32).
+
+    This chain is also the fusion-gap lint's flagged site
+    (``perf-unfused-norm-chain``, analysis/perf_audit.py): in a program
+    whose config says the epilogues fuse, these reference ops appearing
+    in the jaxpr mean the dispatch below silently fell back — the lint
+    CLI traces the fused program under ``P2P_TPU_FORCE_PALLAS=1`` so a
+    regression here (a dispatch-condition typo, a new call site skipping
+    :func:`p2p_tpu.ops.norm.make_norm_act`) fails ``lint --strict``
+    instead of quietly costing a bench round."""
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
     var = jnp.var(x32, axis=(1, 2), keepdims=True)

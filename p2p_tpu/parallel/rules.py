@@ -201,16 +201,56 @@ def make_patchgan_tp_rules(axis_size: int = 2, min_ch: int = 512) -> Tuple:
     )
 
 
+def make_resnet_tp_rules(axis_size: int = 2, min_ch: int = 512) -> Tuple:
+    """The ResNet-trunk Megatron pairs as predicate rules (ISSUE 13
+    satellite — the item-3 worklist drain for the ResNet/pix2pixHD
+    families): each residual block's conv pair (``ConvLayer_0`` C_out →
+    ``ConvLayer_1`` C_in, one psum per block), the encoder's deepest
+    transition (``ConvLayer_3`` → ``ConvLayer_4``) and the decoder's
+    (``UpsampleConvLayer_0`` → ``UpsampleConvLayer_1``) — cityscapes at
+    the generator root, pix2pixHD under its ``global`` subtree, the
+    flagship ExpandNetwork via the ``ResidualBlock`` naming. Kernels
+    only: these trunks run norm layers that absorb no bias and their
+    convs carry none (a model that grows sharded-width biases shows up
+    as a tp-diff gap, which is exactly the worklist's job). The
+    ``(?:^|/)`` anchor keeps ``ConvLayer_3`` from matching inside
+    ``UpsampleConvLayer_3``-style names."""
+    out, inn = _gate_out(axis_size, min_ch), _gate_in(axis_size, min_ch)
+    return (
+        (r"Res(?:net|idual)Block_\d+/ConvLayer_0/Conv_0/kernel$",
+         _OUT_K, out),
+        (r"Res(?:net|idual)Block_\d+/ConvLayer_1/Conv_0/kernel$",
+         _IN_K, inn),
+        (r"(?:^|/)ConvLayer_3/Conv_0/kernel$", _OUT_K, out),
+        (r"(?:^|/)ConvLayer_4/Conv_0/kernel$", _IN_K, inn),
+        (r"(?:^|/)UpsampleConvLayer_0/Conv_0/kernel$", _OUT_K, out),
+        (r"(?:^|/)UpsampleConvLayer_1/Conv_0/kernel$", _IN_K, inn),
+    )
+
+
 def tp_equivalence_rules(cfg, axis_size: int = 2,
                          min_ch: int = 512) -> Optional[Rules]:
     """The declarative table reproducing ``tp_leaf_spec`` for ``cfg``'s
-    model family, or None while the family still needs predicate rules
-    (the remaining tp-diff worklist). Drained so far: the facades family
-    (U-Net generator + PatchGAN discriminators — facades / facades_int8 /
-    edges2shoes_dp). The ResNet/pix2pixHD trunk families stay on
-    :data:`REPLICATED_RULES` until their pair rules land here."""
-    if cfg.model.generator == "unet":
+    model family, or None for an unknown family. ALL preset families are
+    drained (zero tp-diff gaps, pinned + CI-grepped): the facades family
+    (U-Net G + PatchGAN D), and — ISSUE 13 — the ResNet/pix2pixHD/Expand
+    trunks plus their multiscale PatchGAN discriminators.
+
+    The trunk rules join the table only when the family's widest trunk
+    conv can clear the ``min_ch`` floor (pix2pixHD's global trunk tops
+    out at ``16·ngf``, the plain ResNet/Expand trunks at ``4·ngf``) —
+    below it every trunk gate is provably never-true and the rules would
+    only audit as dead. The audit + tp-diff pins in tests/test_analysis
+    verify the width law against the real preset states."""
+    gen = cfg.model.generator
+    if gen == "unet":
         return (make_unet_tp_rules(axis_size, min_ch)
                 + make_patchgan_tp_rules(axis_size, min_ch)
+                + ((r".*", P()),))
+    if gen in ("resnet", "pix2pixhd", "expand"):
+        trunk_top = cfg.model.ngf * (16 if gen == "pix2pixhd" else 4)
+        trunk = (make_resnet_tp_rules(axis_size, min_ch)
+                 if trunk_top >= min_ch else ())
+        return (trunk + make_patchgan_tp_rules(axis_size, min_ch)
                 + ((r".*", P()),))
     return None

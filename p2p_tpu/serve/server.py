@@ -7,7 +7,10 @@ Endpoints (full reference: docs/SERVING.md "HTTP API"):
   (PNG/JPEG...); response: the translated image as PNG. ``{model}`` is a
   tenant alias from the :class:`~p2p_tpu.serve.tenancy.ModelRegistry`.
   Status codes carry the overload semantics of docs/RESILIENCE.md over
-  HTTP: 429 = shed (queue full — back off), 503 = draining (SIGTERM
+  HTTP: 429 = shed (queue full) or per-tenant admission quota
+  (``--tenant_quota`` in-flight cap, ``serve_quota_rejected_total`` —
+  the fairness guard so one tenant's burst cannot starve the rest),
+  503 = draining (SIGTERM
   received; retry against another replica), 504 = deadline expired
   before dispatch, 422 = poison input (decode failed ``max_attempts``
   times), 404 = unknown tenant, 413/411 = body too large / no length.
@@ -62,6 +65,21 @@ _TRANSLATE_RE = re.compile(r"^/v1/([^/]+)/translate$")
 MAX_BODY_BYTES = 32 * 1024 * 1024
 
 
+class TenantQuotaExceeded(RuntimeError):
+    """Admission refused: the tenant already has ``quota`` requests in
+    flight (admitted and not yet answered). The per-tenant fairness
+    guard — one tenant's burst can fill the shared responder pool and
+    its own queue, but it cannot consume every OTHER tenant's admission
+    slots (the ROADMAP item-1 starvation gap). Maps to 429 +
+    ``serve_quota_rejected_total``."""
+
+    def __init__(self, tenant: str, quota: int):
+        self.tenant = tenant
+        self.quota = quota
+        super().__init__(
+            f"tenant {tenant!r} quota exceeded ({quota} in flight)")
+
+
 @dataclasses.dataclass
 class HttpRequest(Request):
     """A queued HTTP request: the body bytes ride in ``payload``; the
@@ -75,6 +93,10 @@ class HttpRequest(Request):
     out_body: bytes = b""
     out_type: str = "application/json"
     out_headers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # fired exactly once on the FIRST completion, whichever path answers
+    # (responder 200, poison 422, deadline 504, drain 503, engine 500) —
+    # the quota accounting's release hook (see ServeApp.submit)
+    on_complete: Optional[Any] = None
 
     def complete(self, status: int, body: bytes,
                  content_type: str = "application/json",
@@ -87,6 +109,18 @@ class HttpRequest(Request):
         if headers:
             self.out_headers = dict(headers)
         self.done.set()
+        cb = self.consume_on_complete()
+        if cb is not None:
+            cb(self)
+
+    def consume_on_complete(self):
+        """Atomically take (and disarm) the completion hook. ``dict.pop``
+        is a single C call under the GIL, so a double-complete race (the
+        handler's response-timeout claim vs the responder's 200) hands
+        the hook to exactly ONE caller — the quota slot can never be
+        released twice for one acquisition. After the pop, attribute
+        lookup falls back to the dataclass default (None)."""
+        return self.__dict__.pop("on_complete", None)
 
 
 def _json_body(payload: Dict[str, Any]) -> bytes:
@@ -101,8 +135,15 @@ class _TenantRuntime:
                  max_queue: int, deadline_s: Optional[float],
                  linger_s: float, group_cap: Optional[int],
                  max_attempts: int, retry_delay_s: float,
-                 max_queue_bytes: Optional[int]):
+                 max_queue_bytes: Optional[int],
+                 quota: Optional[int] = None):
         self.tenant = tenant
+        # per-tenant admission quota (None = unlimited): in-flight =
+        # admitted and not yet completed; counted under its own lock
+        # (handler threads admit, responder/dispatch threads release)
+        self.quota = quota
+        self.inflight = 0
+        self._quota_lock = threading.Lock()
         self.queue = BoundedRequestQueue(
             max_depth=max_queue, deadline_s=deadline_s,
             registry=app.registry, tenant=tenant.alias,
@@ -125,6 +166,8 @@ class _TenantRuntime:
         alias = tenant.alias
         self._poisoned = app.registry.counter(
             "serve_quarantined_total", tenant=alias)
+        self._quota_rejected = app.registry.counter(
+            "serve_quota_rejected_total", tenant=alias)
         self._latency = app.registry.histogram(
             "serve_request_latency_seconds", tenant=alias)
         self._rate = app.registry.ewma(
@@ -170,10 +213,25 @@ class _TenantRuntime:
         self.on_expired = on_expired
         self.thread: Optional[threading.Thread] = None
 
+    def try_acquire_slot(self) -> bool:
+        """Take one in-flight slot; False = the tenant is at quota."""
+        with self._quota_lock:
+            if self.quota is not None and self.inflight >= self.quota:
+                self._quota_rejected.inc()
+                return False
+            self.inflight += 1
+            return True
+
+    def release_slot(self, _req=None) -> None:
+        with self._quota_lock:
+            if self.inflight > 0:
+                self.inflight -= 1
+
     def status(self) -> Dict[str, Any]:
         s = self.tenant.status()
         s["queue_depth"] = len(self.batcher)
         s["served"] = self.loop.served
+        s["inflight"] = self.inflight
         return s
 
 
@@ -188,7 +246,8 @@ class ServeApp:
                  linger_ms: float = 10.0, group_cap: Optional[int] = None,
                  max_attempts: int = 3, retry_delay_ms: float = 1000.0,
                  response_timeout_s: Optional[float] = None,
-                 max_queue_bytes: int = 256 * 1024 * 1024):
+                 max_queue_bytes: int = 256 * 1024 * 1024,
+                 tenant_quota: Optional[int] = None):
         if registry is None:
             from p2p_tpu.obs import get_registry
 
@@ -205,7 +264,10 @@ class ServeApp:
             # count-capped AND byte-capped admission: queued request
             # bodies are host RAM; depth alone would admit
             # max_queue × 32 MiB before the first shed
-            max_queue_bytes=max_queue_bytes)
+            max_queue_bytes=max_queue_bytes,
+            # per-tenant in-flight cap (429 + serve_quota_rejected_total)
+            # so one tenant's burst cannot starve the others' slots
+            quota=tenant_quota)
         self.deadline_ms = deadline_ms
         if response_timeout_s is not None:
             self.response_timeout_s = response_timeout_s  # explicit wins
@@ -242,12 +304,26 @@ class ServeApp:
     # -------------------------------------------------------- requests
     def submit(self, alias: str, body: bytes) -> Optional[HttpRequest]:
         """Admit one translate request; None = shed/draining (the
-        handler maps via :attr:`draining`)."""
+        handler maps via :attr:`draining`); raises
+        :class:`TenantQuotaExceeded` when the tenant is at its in-flight
+        cap (``--tenant_quota``). The slot is released on the request's
+        FIRST completion — whichever path answers it — via the
+        ``on_complete`` hook; a shed request never entered the system,
+        so its slot releases here."""
         rt = self._runtimes[alias]
+        if not rt.try_acquire_slot():
+            raise TenantQuotaExceeded(alias, rt.quota)
         req = HttpRequest(name=f"{alias}/{next(self._seq)}",
-                          enqueued_at=0.0, payload=body)
+                          enqueued_at=0.0, payload=body,
+                          on_complete=rt.release_slot)
         out = rt.batcher.submit_request(req)
-        if out is not None:
+        if out is None:
+            # atomically disarm the hook and release here: a future path
+            # that answers a shed request via complete() must not
+            # release the same acquisition twice
+            if req.consume_on_complete() is not None:
+                rt.release_slot(req)
+        else:
             rt._rate.mark()
         return out  # type: ignore[return-value]
 
@@ -346,6 +422,7 @@ class ServeApp:
                 "deadline_expired": rt.queue.expired_count,
                 "quarantined": int(rt._poisoned.value),
                 "decode_retries": rt.loop.decode_retries,
+                "quota_rejected": int(rt._quota_rejected.value),
                 "hot_swaps": rt.tenant.swap_count,
                 "batch_occupancy_mean": round(occ, 4)
                 if occ is not None else None,
@@ -462,7 +539,19 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None:
             return
-        req = app.submit(alias, body)
+        try:
+            req = app.submit(alias, body)
+        except TenantQuotaExceeded as e:
+            # per-tenant fairness refusal: same 429/Retry-After contract
+            # as the shed path, its own counter + error body so a tenant
+            # can tell "server full" from "YOU are at quota"
+            self._send(429, _json_body(
+                {"error": f"tenant quota exceeded "
+                          f"({e.quota} requests in flight)"}),
+                extra={"Retry-After": "1"})
+            app.registry.counter("serve_http_requests_total",
+                                 tenant=alias, code="429").inc()
+            return
         if req is None:
             if app.draining:
                 code = "503"

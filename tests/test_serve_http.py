@@ -423,3 +423,121 @@ def test_http_server_end_to_end(tmp_path, fresh_registry):
     assert summaries["m1"]["n_compiles"] == 2
     assert summaries["m1"]["hot_swaps"] == 1
     assert summaries["m1"]["quarantined"] == 1     # the poison body
+
+
+# ------------------------------------------- per-tenant quotas (ISSUE 13)
+
+
+class _FakeEngine:
+    buckets = (1, 2, 4)
+    n_compiles = 0
+
+
+class _FakeTenant:
+    """Just enough Tenant surface for admission-side tests: the quota
+    ladder runs entirely before any engine work, so no checkpoint or
+    compile is needed."""
+
+    def __init__(self, alias="qa"):
+        self.alias = alias
+        self.step = 0
+        self.engine = _FakeEngine()
+        self.cfg = get_preset("facades")
+        self.swap_count = 0
+
+    def status(self):
+        return {"step": 0, "buckets": list(self.engine.buckets),
+                "n_compiles": 0, "swaps": 0}
+
+
+def test_tenant_quota_rejects_then_releases_on_completion(fresh_registry):
+    """--tenant_quota: the (quota+1)-th in-flight request is refused with
+    TenantQuotaExceeded + serve_quota_rejected_total; completing ANY
+    admitted request (whichever path answers it) releases its slot and
+    admission resumes. A second tenant is untouched — the fairness
+    point."""
+    from p2p_tpu.obs import get_registry
+    from p2p_tpu.serve.server import (
+        ServeApp,
+        TenantQuotaExceeded,
+        _TenantRuntime,
+    )
+
+    reg = get_registry()
+    app = ServeApp(registry=reg, max_queue=32, tenant_quota=2)
+    app.tenants.add(_FakeTenant("qa"))
+    app._runtimes["qa"] = rt = _TenantRuntime(
+        app, app.tenants.get("qa"), **app._rt_kw)
+    app.tenants.add(_FakeTenant("qb"))
+    app._runtimes["qb"] = _TenantRuntime(
+        app, app.tenants.get("qb"), **app._rt_kw)
+
+    r1 = app.submit("qa", b"one")
+    r2 = app.submit("qa", b"two")
+    assert r1 is not None and r2 is not None and rt.inflight == 2
+    with pytest.raises(TenantQuotaExceeded) as ei:
+        app.submit("qa", b"three")
+    assert ei.value.tenant == "qa" and ei.value.quota == 2
+    assert reg.counter("serve_quota_rejected_total",
+                       tenant="qa").value == 1
+    # the OTHER tenant's slots are untouched by qa's saturation
+    assert app.submit("qb", b"x") is not None
+
+    # any completion path releases the slot exactly once
+    r1.complete(200, b"ok", "image/png")
+    r1.complete(504, b"late duplicate")   # no-op: first completion won
+    assert rt.inflight == 1
+    r4 = app.submit("qa", b"four")
+    assert r4 is not None and rt.inflight == 2
+
+    # the /healthz + serve_summary surfaces carry the accounting
+    assert rt.status()["inflight"] == 2
+    summ = {s["tenant"]: s for s in app.summaries()}
+    assert summ["qa"]["quota_rejected"] == 1
+    assert summ["qb"]["quota_rejected"] == 0
+
+
+def test_tenant_quota_shed_path_releases_slot(fresh_registry):
+    """A request that is SHED at the queue (never admitted) must hand
+    its quota slot straight back — shed and quota are independent
+    refusals."""
+    from p2p_tpu.obs import get_registry
+    from p2p_tpu.serve.server import ServeApp, _TenantRuntime
+
+    app = ServeApp(registry=get_registry(), max_queue=1, tenant_quota=8)
+    app.tenants.add(_FakeTenant("qs"))
+    app._runtimes["qs"] = rt = _TenantRuntime(
+        app, app.tenants.get("qs"), **app._rt_kw)
+    assert app.submit("qs", b"a") is not None      # fills max_queue=1
+    assert app.submit("qs", b"b") is None          # shed by the queue
+    assert rt.inflight == 1                        # slot released
+
+
+def test_tenant_quota_unlimited_by_default(fresh_registry):
+    from p2p_tpu.obs import get_registry
+    from p2p_tpu.serve.server import ServeApp, _TenantRuntime
+
+    app = ServeApp(registry=get_registry(), max_queue=64)
+    app.tenants.add(_FakeTenant("qu"))
+    app._runtimes["qu"] = rt = _TenantRuntime(
+        app, app.tenants.get("qu"), **app._rt_kw)
+    for i in range(16):
+        assert app.submit("qu", bytes([i])) is not None
+    assert rt.inflight == 16 and rt.quota is None
+
+
+def test_quota_slot_releases_exactly_once_under_double_complete(
+        fresh_registry):
+    """The timeout-claim vs responder race: however many paths complete
+    one request, its quota slot releases exactly once
+    (HttpRequest.consume_on_complete is an atomic take)."""
+    from p2p_tpu.serve.server import HttpRequest
+
+    released = []
+    req = HttpRequest(name="r", enqueued_at=0.0, payload=b"x",
+                      on_complete=released.append)
+    req.complete(504, b"")          # the handler's timeout claim
+    req.complete(200, b"png", "image/png")   # the late responder
+    assert req.status == 504        # first completion won
+    assert released == [req]        # ...and released exactly once
+    assert req.consume_on_complete() is None
