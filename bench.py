@@ -24,7 +24,7 @@ Env knobs: BENCH_PRESET, BENCH_BS (per-chip batch), BENCH_STEPS, BENCH_IMG;
 BENCH_JSONL=<path> additionally appends the record (kind="bench") to that
 metrics stream through the obs registry.
 
-``--sweep`` runs the nine BASELINE.md contract rows (headline, bs=1,
+``--sweep`` runs the ten BASELINE.md contract rows (headline, bs=1,
 edges2shoes int8-delayed, cityscapes, pix2pixhd, vid2vid, the round-6
 int8-multiscale-D and pallas-fusion rows, and the round-7 open-loop
 serving row) and diffs each against the
@@ -124,9 +124,14 @@ def _phase_breakdown(cfg, state, host_batch, dtype, scan_k, rtt) -> dict:
         return sum(jnp.mean(jnp.square(p.astype(jnp.float32)))
                    for p in jax.tree_util.tree_leaves(preds))
 
+    c_vars = {"batch_stats": state.batch_stats_c}
+    if use_quant and state.quant_c is not None:
+        # net_c on the delayed-int8 path (int8_compression) reads its
+        # stored scales like G/D do
+        c_vars["quant"] = state.quant_c
+
     def c_loss(params, x):
-        out = c.apply({"params": params,
-                       "batch_stats": state.batch_stats_c}, x, False)
+        out = c.apply({"params": params, **c_vars}, x, False)
         return jnp.mean(jnp.square(out.astype(jnp.float32)))
 
     def perturb(x, eps):
@@ -235,6 +240,16 @@ def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8=True, int8_generator=both))
         preset = preset + ("_i8gd" if both else "_i8d")
+    if os.environ.get("BENCH_INT8_FULL", "") == "1":
+        # full-model delayed int8 (ISSUE 14): the ONE shared override
+        # set (core.config.int8_full_coverage — generator encoder+
+        # decoder, D inner+kn2row head, net_c; stems/image head stay
+        # bf16 per their dated waivers), identical to the program the
+        # lint's train_step[facades_int8_full] roofline row audits
+        from p2p_tpu.core.config import int8_full_coverage
+
+        cfg = int8_full_coverage(cfg)
+        preset = preset + "_i8full"
     if (os.environ.get("BENCH_DELAYED", "") == "1"
             and not cfg.model.int8_delayed):
         # delayed (stored-scale) activation quantization, ops/int8.py
@@ -750,6 +765,14 @@ SWEEP_ROWS = [
      "env": {"BENCH_PRESET": "cityscapes_spatial",
              "BENCH_NORM": "pallas_instance"},
      "band": None},
+    # round-8 row (ISSUE 14): FULL-model delayed int8 on the headline
+    # facades config — the drained-worklist coverage set
+    # (core.config.int8_full_coverage: generator encoder+decoder, D
+    # inner convs + kn2row head, net_c; stems/image head bf16 per their
+    # dated waivers). Band-pending until measured on-chip; the lint's
+    # train_step[facades_int8_full] roofline row is its static twin.
+    {"name": "facades_int8_full", "env": {"BENCH_INT8_FULL": "1"},
+     "band": None},
     # round-7 row (ISSUE 12): the open-loop serving-latency row — the
     # continuous-batching stack behind the HTTP frontend (run_serve);
     # value is served img/sec, the record carries p50/p99 request latency
@@ -774,7 +797,8 @@ def run_sweep(dry_run: bool = False) -> int:
     # the sweep owns these knobs; a stray env override would silently
     # bench a different contract than the bands record
     owned = ("BENCH_PRESET", "BENCH_BS", "BENCH_INT8", "BENCH_DELAYED",
-             "BENCH_IMG", "BENCH_NORM", "BENCH_NORMD", "BENCH_BREAKDOWN")
+             "BENCH_IMG", "BENCH_NORM", "BENCH_NORMD", "BENCH_BREAKDOWN",
+             "BENCH_INT8_FULL")
     saved = {k: os.environ.pop(k) for k in owned if k in os.environ}
     if saved:
         print(f"note: ignoring {sorted(saved)} for --sweep",
@@ -792,6 +816,9 @@ def run_sweep(dry_run: bool = False) -> int:
             return None          # the traced set models train/eval steps
         env = row["env"]
         preset = env.get("BENCH_PRESET", "facades_int8")
+        if env.get("BENCH_INT8_FULL"):
+            # the full-coverage overlay has its own canonical row
+            return roofline_row_for("facades_int8_full")
         if env.get("BENCH_INT8"):
             return (roofline_row_for("facades_int8")
                     if preset in ("facades", "edges2shoes_dp") else None)
@@ -860,7 +887,7 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sweep", action="store_true",
-                    help="run all nine BASELINE.md contract rows and fail "
+                    help="run all ten BASELINE.md contract rows and fail "
                          "on >3% regression below the recorded band "
                          "(band-less rows report without gating)")
     ap.add_argument("--infer", action="store_true",

@@ -33,12 +33,17 @@ findings/waiver conventions of docs/STATIC_ANALYSIS.md:
 - **int8-coverage worklist** (``perf-int8-coverage-gap``,
   :func:`int8_coverage`): in a program whose config enables the
   delayed-int8 path, every ``conv_general_dilated`` / ``dot_general``
-  still contracting in bf16/f32 is unconverted MXU work — today the
-  D-side beyond what ISSUE 6 quantized, the C network, and the
-  deliberately-bf16 stems/heads. Info severity (the ROADMAP item-2
-  twin of the item-3 tp-diff worklist: CLI ``--int8-diff`` prints it,
-  CI asserts it NON-empty until the quantization lever drains it),
-  deduped per source line like ``jaxpr-f32-leak``.
+  still contracting in bf16/f32 is unconverted MXU work. ISSUE 14
+  DRAINED the worklist: the lint CLI audits the full-coverage program
+  (``core.config.int8_full_coverage``), where every site is either
+  quantized (U-Net encoder+decoder, all D inner convs, the kn2row D
+  head, net_c) or carries a dated in-source waiver stating its verdict
+  (measured-rejected HBM-bound stems and the U-Net image head; the
+  per-form dispatch table's bf16 backward contractions, which jax
+  attributes to the custom-VJP call sites). Waived sites leave the
+  worklist, so CLI ``--int8-diff`` prints 0 and CI asserts emptiness —
+  any NEW bf16/f32 contraction in the program is a live line again.
+  Info severity, deduped per source line like ``jaxpr-f32-leak``.
 """
 
 from __future__ import annotations
@@ -256,9 +261,11 @@ def int8_coverage(jaxpr, tag: str = "program",
                   ) -> Tuple[List[dict], List[Finding]]:
     """``(worklist, findings)`` enumerating conv/dot eqns still
     contracting in bf16/f32 inside a delayed-int8 program. Info severity
-    — the migration worklist ROADMAP item 2's quantization lever drains,
-    mirroring ``--tp-diff``: entries carry op, operand dtypes, shapes and
-    ``file:line``; one entry per source line with an eqn count."""
+    — the ROADMAP item-2 worklist, drained by ISSUE 14: the caller runs
+    the findings through ``apply_pragma_waivers`` and drops waived sites
+    from the worklist (a dated waiver IS a drained verdict). Entries
+    carry op, operand dtypes, shapes and ``file:line``; one entry per
+    source line with an eqn count."""
     agg: Dict[Tuple, dict] = {}
     # descend everything EXCEPT pallas_call kernels (block-shaped refs)
     def walk(jx):

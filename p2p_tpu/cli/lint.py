@@ -40,8 +40,14 @@ unwaived finding:
    fused program), the collective-overlap audit
    (``perf-serialized-collective`` over the overlap-scheduled PP
    program), and the delayed-int8 coverage worklist (``--int8-diff``,
-   mirroring ``--tp-diff`` — info severity, CI asserts it non-empty
-   until ROADMAP item 2's quantization lever drains it).
+   mirroring ``--tp-diff``). ISSUE 14 DRAINED the worklist: it audits
+   the full-coverage program (``train_step[facades_int8_full]`` =
+   ``core.config.int8_full_coverage``, the same override set the
+   ``BENCH_INT8_FULL`` bench row measures) where every conv/dot is
+   either quantized or carries a dated in-source waiver (measured-
+   rejected stems/head, per-form dispatch-table backward islands) — CI
+   asserts "0 sites" so a lost quantized route or an unknobbed new
+   layer reappears as a live worklist line and fails the gate.
 
 Waivers: ``# p2p-lint: disable=<rule> -- reason`` in source (findings
 carry eqn source locations, so even jaxpr findings waive in-source); the
@@ -88,9 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "line per leaf")
     p.add_argument("--int8-diff", action="store_true", dest="int8_diff",
                    help="also print the delayed-int8 coverage worklist "
-                        "(ROADMAP item 2): every conv/dot still "
-                        "contracting in bf16/f32 inside the int8 traced "
-                        "programs, one line per source site")
+                        "(ROADMAP item 2, DRAINED by ISSUE 14): every "
+                        "conv/dot still contracting in bf16/f32 inside "
+                        "the full-coverage int8 program without a dated "
+                        "waiver, one line per source site — 0 is the "
+                        "gated state")
     p.add_argument("--perf-budget", type=str, default=None,
                    dest="perf_budget", metavar="PATH",
                    help="ALSO write the static roofline table "
@@ -380,9 +388,15 @@ def run_traced_analyses(report, programs=None):
     report.extend(apply_pragma_waivers(findings))
 
 
-def _int8_train_program():
-    """The delayed-int8 GAN train step's jaxpr (tiny facades_int8) —
-    the program the int8-coverage worklist enumerates."""
+def _int8_train_program(full: bool = False):
+    """The delayed-int8 GAN train step's jaxpr (tiny facades_int8).
+
+    ``full=True`` traces the FULL-COVERAGE variant
+    (``core.config.int8_full_coverage`` — every ISSUE-14 knob on, the
+    same override set ``bench.py``'s ``BENCH_INT8_FULL`` row measures):
+    the program the drained int8-coverage worklist audits. The plain
+    variant stays the roofline row for the shipping preset (the headline
+    bench row's program)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -391,6 +405,10 @@ def _int8_train_program():
     from p2p_tpu.train.step import build_train_step
 
     cfg = _tiny_cfg("facades_int8")
+    if full:
+        from p2p_tpu.core.config import int8_full_coverage
+
+        cfg = int8_full_coverage(cfg)
     batch = _tiny_batch(cfg)
     sds = _sds_tree(jax.eval_shape(lambda: create_train_state(
         cfg, jax.random.key(0),
@@ -464,6 +482,9 @@ def _ensure_perf_programs(programs):
                                    jit=False))(vsds, vbatch)
     if "train_step[facades_int8]" not in programs:
         programs["train_step[facades_int8]"] = _int8_train_program()
+    if "train_step[facades_int8_full]" not in programs:
+        programs["train_step[facades_int8_full]"] = _int8_train_program(
+            full=True)
     if "train_step[cityscapes_pallas]" not in programs:
         programs["train_step[cityscapes_pallas]"] = _fused_train_program()
     if "pp_train_step[reference]" not in programs:
@@ -497,9 +518,19 @@ def run_perf_analyses(report, programs):
     if pp is not None:
         findings.extend(serialized_collective_findings(
             pp, tag="pp_train_step[reference]"))
+    # The coverage worklist audits the FULL-COVERAGE program (ISSUE 14
+    # drained it): every conv/dot there is either quantized or carries a
+    # dated in-source waiver naming its measured-rejected / dispatch-
+    # table verdict — waived sites leave the worklist, so "0 sites" is
+    # the gate and ANY new bf16/f32 contraction (a lost QuantConv route,
+    # a new layer without a knob) reappears as a live worklist line.
     worklist, info = int8_coverage(
-        programs["train_step[facades_int8]"],
-        tag="train_step[facades_int8]")
+        programs["train_step[facades_int8_full]"],
+        tag="train_step[facades_int8_full]")
+    info = apply_pragma_waivers(info)
+    waived_sites = {(f.file, f.line) for f in info if f.waived}
+    worklist = [w for w in worklist
+                if (w["file"], w["line"]) not in waived_sites]
     report.extend(apply_pragma_waivers(findings))
     report.extend(info)
     return worklist

@@ -57,6 +57,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--int8_generator", action="store_true", default=None,
                    help="extend --int8 to the generator convs (measured "
                         "slower on v5e at 256^2; see ModelConfig)")
+    p.add_argument("--int8_stem", action="store_true", default=None,
+                   help="extend the int8 path to the 3/6-channel input "
+                        "stems (U-Net down0, PatchGAN stage 0, net_c's "
+                        "k5 conv). Off by default: the stems are "
+                        "HBM-bound — measured-rejected on v5e, kept "
+                        "measurable per chip/shape")
+    p.add_argument("--int8_head", action="store_true", default=None,
+                   help="discriminator logits head on the int8 kn2row "
+                        "tap-decomposition path (ops/int8.py "
+                        "int8_kn2row_conv); the U-Net IMAGE head always "
+                        "stays bf16")
+    p.add_argument("--int8_compression", action="store_true", default=None,
+                   help="CompressionNetwork (net_c) convs on the int8 "
+                        "path; its amax state rides the 'quant' "
+                        "collection as quant_c end-to-end")
+    p.add_argument("--int8_fused_epilogue", action="store_true",
+                   default=None,
+                   help="fuse the D inner-conv epilogue [instance norm + "
+                        "LeakyReLU + quantize + amax] into one streaming "
+                        "Pallas pass (needs --norm_d pallas_instance and "
+                        "--int8_delayed; ops/pallas/norm_act.py)")
     p.add_argument("--int8_delayed", action=argparse.BooleanOptionalAction,
                    default=None,
                    help="delayed (stored-scale) activation quantization: "
@@ -260,6 +281,9 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                  upsample_mode=args.upsample_mode, int8=args.int8,
                  int8_generator=args.int8_generator,
                  int8_delayed=args.int8_delayed,
+                 int8_stem=args.int8_stem, int8_head=args.int8_head,
+                 int8_compression=args.int8_compression,
+                 int8_fused_epilogue=args.int8_fused_epilogue,
                  legacy_layout=args.legacy_layout,
                  thin_head=args.thin_head, norm_d=args.norm_d)
     loss = over(loss, lambda_l1=args.lamb, lambda_vgg=args.lambda_vgg,

@@ -99,6 +99,39 @@ class ModelConfig:
     # correcting round 2's hypothesis). Transient clipping after an
     # activation spike decays in one step (decaying-max update).
     int8_delayed: bool = False
+    # ISSUE 14 coverage knobs (one per remaining --int8-diff site family;
+    # every site is REACHABLE on the int8 path, and the default carries
+    # the measured-rejected verdict where there is one):
+    # 3/6-channel input stems (U-Net down0, the PatchGAN stage-0 conv,
+    # net_c's k5 RGB conv) on the int8 path. Off by default: the stems
+    # are HBM-bound (the MXU gains nothing on a 3-wide contraction) —
+    # the round-2..5 doctrine — but the knob keeps the form measurable
+    # per chip/shape (BENCH_INT8_FULL flips it for the band-pending row).
+    int8_stem: bool = False
+    # Discriminator logits head on the int8 path: the kn2row-eligible
+    # 512→1 head runs the s8×s8→s32 tap-decomposition dot
+    # (ops/int8.py int8_kn2row_conv — fwd and wgrad on the int8 MXU,
+    # the tiny-contraction dgrad stays bf16 per the per-form dispatch
+    # table); a non-kn2row head falls back to QuantConv. The U-Net
+    # IMAGE head stays bf16 always (quality + HBM critical — the dated
+    # in-source waiver at models/unet.py documents the verdict).
+    int8_head: bool = False
+    # CompressionNetwork (net_c) convs on the int8 path. Its output is
+    # already crushed to `quant_bits` (3) by the pipeline quantizer, so
+    # int8 QAT noise inside the pre-filter is far below the signal the
+    # net is trained to survive; amax state joins the 'quant' collection
+    # as quant_c (train step, PP, frozen-scale eval/serving, elastic
+    # reshard_amax all thread it).
+    int8_compression: bool = False
+    # Quantize-fused conv epilogues (ops/pallas/norm_act.py
+    # norm_act_quant): with norm_d="pallas_instance" + int8_delayed the
+    # discriminator's inner-conv epilogue [instance norm + LeakyReLU +
+    # clip/round quantize + amax measurement] runs as ONE streaming
+    # Pallas pass, so the newly quantized conv does not pay a separate
+    # full-size read+write for the clip/round; the consumer conv takes
+    # the prequantized activation (int8_conv_pq). Requires int8 +
+    # int8_delayed + a stateless instance-family norm_d.
+    int8_fused_epilogue: bool = False
     # Keep the mathematically-dead conv biases in front of mean-
     # subtracting norms (round-2 checkpoint param layout). Default False:
     # those biases are exactly cancelled by the norm in forward AND
@@ -495,6 +528,32 @@ def get_preset(name: str) -> Config:
         return _PRESETS[name]
     except KeyError:
         raise KeyError(f"unknown preset {name!r}; have {sorted(_PRESETS)}") from None
+
+
+def int8_full_coverage(cfg: Config) -> Config:
+    """The ONE definition of "full-model delayed int8" (ISSUE 14): every
+    coverage knob the --int8-diff worklist drained, on top of ``cfg``.
+
+    Shared by the lint CLI (the ``train_step[facades_int8_full]`` traced
+    program the coverage worklist audits) and ``bench.py``'s
+    ``BENCH_INT8_FULL`` band-pending sweep row, so the statically audited
+    program and the measured one can never drift apart. Deliberately NOT
+    flipped: ``int8_stem`` (HBM-bound 3/6-ch stems — the measured-rejected
+    verdict carried by dated in-source waivers) and the U-Net image head
+    (quality + HBM critical, no knob)."""
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(
+            cfg.model,
+            int8=True,
+            int8_delayed=True,
+            int8_generator=True,
+            int8_decoder=True,
+            int8_head=True,
+            use_compression_net=True,
+            int8_compression=True,
+        ),
+    )
 
 
 def list_presets():

@@ -25,17 +25,34 @@ from p2p_tpu.ops.pixel_shuffle import pixel_shuffle
 
 class CompressionNetwork(nn.Module):
     features: int = 64
+
+    # int8 QAT path (ops/int8.py, ISSUE 14): all three convs through
+    # QuantConv — including the k5 RGB stem, because net_c's OUTPUT is
+    # crushed to `quant_bits` (3) by the pipeline quantizer immediately
+    # after, so int8 QAT noise inside the pre-filter sits far below the
+    # signal the net is trained to survive (the stem's HBM-bound caveat
+    # from the G/D doctrine is noted in docs/PERFORMANCE.md; the
+    # per-net knob lets on-chip measurement overrule). ``int8_delayed``
+    # stores the activation amax in a 'quant' collection the train step
+    # threads as ``quant_c`` (frozen at eval/serve, remapped by the
+    # elastic ``reshard_amax`` law like quant_g/quant_d).
+    int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         identity = x
-        y = ConvLayer(self.features, kernel_size=5, dtype=self.dtype)(x)
+        i8, dly = self.int8, self.int8_delayed
+        y = ConvLayer(self.features, kernel_size=5, int8=i8,
+                      int8_delayed=dly, dtype=self.dtype)(x)
         y = PReLU()(y)
-        y = ConvLayer(self.features, kernel_size=3, dtype=self.dtype)(y)
+        y = ConvLayer(self.features, kernel_size=3, int8=i8,
+                      int8_delayed=dly, dtype=self.dtype)(y)
         y = BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
         y = PReLU()(y)
-        y = ConvLayer(12, kernel_size=3, stride=2, dtype=self.dtype)(y)
+        y = ConvLayer(12, kernel_size=3, stride=2, int8=i8,
+                      int8_delayed=dly, dtype=self.dtype)(y)
         y = pixel_shuffle(y, 2)
         # Per-pixel L2 normalization over channels (torch F.normalize dim=1).
         norm = jnp.maximum(jnp.linalg.norm(y, axis=-1, keepdims=True), 1e-12)

@@ -24,7 +24,7 @@ classic pix2pix is the num_D=1, no-SN, no-interm-feat corner of this module.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -101,9 +101,13 @@ class _PlainConv(nn.Module):
     stride: int
     padding: int = 2
     # int8 QAT MXU path (ops/int8.py) — set by NLayerDiscriminator on
-    # its wide inner convs only.
+    # its wide inner convs (and, under int8_stem/int8_head, the stem
+    # and logits head).
     int8: bool = False
     int8_delayed: bool = False
+    # quantize-fused input epilogue threading (ops/int8.py QuantConv)
+    epilogue: Optional[Callable] = None
+    epilogue_tap: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -111,7 +115,9 @@ class _PlainConv(nn.Module):
         if isinstance(x, (tuple, list)):
             # unconcatenated conditional pair — the split-stem path
             # (param tree identical to the concat path: Conv_0 holds the
-            # full 6-channel kernel)
+            # full 6-channel kernel). Stays bf16 even under int8_stem:
+            # the split form exists precisely because the halves are
+            # HBM-bound image reads.
             a, b = x
             return _SplitStemConv(
                 self.features, stride=self.stride, padding=self.padding,
@@ -120,10 +126,12 @@ class _PlainConv(nn.Module):
         if self.stride == 1 and self.features * 16 <= x.shape[-1]:
             # thin head (e.g. 512→1): kn2row matmul decomposition — the
             # MXU conv runs at 3-6 TF/s with one live output lane; this
-            # form is one full-rate HBM pass over x (ops/conv.py).
+            # form is one full-rate HBM pass over x (ops/conv.py). With
+            # int8 the tap dot runs s8×s8→s32 (int8_kn2row_conv).
             return KN2RowConv(self.features, kernel_size=4,
-                              padding=self.padding, dtype=self.dtype,
-                              name="Conv_0")(x)
+                              padding=self.padding, int8=self.int8,
+                              int8_delayed=self.int8_delayed,
+                              dtype=self.dtype, name="Conv_0")(x)
         if self.int8:
             from p2p_tpu.ops.int8 import QuantConv
 
@@ -132,7 +140,9 @@ class _PlainConv(nn.Module):
                 padding=self.padding, dtype=self.dtype,
                 kernel_init=normal_init(), name="Conv_0",
                 delayed=self.int8_delayed,
+                epilogue=self.epilogue, epilogue_tap=self.epilogue_tap,
             )(x)
+        # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 measured-rejected: only the 6-ch stage-0 stem reaches this line under delayed-int8 (inner convs take the int8 branch above, the head the kn2row branch); the 6-wide contraction leaves the MXU idle in any dtype — HBM-bound, the rounds 2-5 stems-stay-bf16 doctrine. ModelConfig.int8_stem keeps the form measurable per chip.
         return save_conv_out(nn.Conv(
             self.features,
             kernel_size=(4, 4),
@@ -149,12 +159,21 @@ class NLayerDiscriminator(nn.Module):
     use_spectral_norm: bool = True
     use_sigmoid: bool = False
     get_interm_feat: bool = True
-    # int8 QAT path for the wide inner convs (stages 1..n_layers); the
-    # 6-ch stem and the 1-ch head stay bf16. Composes with spectral
-    # norm: the power iteration tracks the true f32 weight and only the
-    # normalized w/σ is quantized (SpectralConv.int8).
+    # int8 QAT path for the wide inner convs (stages 1..n_layers); by
+    # default the 6-ch stem and the 1-ch head stay bf16. Composes with
+    # spectral norm: the power iteration tracks the true f32 weight and
+    # only the normalized w/σ is quantized (SpectralConv.int8).
     int8: bool = False
     int8_delayed: bool = False
+    # ISSUE 14 coverage knobs (core/config.py ModelConfig docs):
+    # int8_stem quantizes the stage-0 conv (concat form only — the
+    # split-pair stem stays bf16 by design); int8_head runs the logits
+    # head on the int8 kn2row path; int8_fused_epilogue fuses each inner
+    # conv's input epilogue [norm+LeakyReLU+quantize+amax] into one
+    # streaming pass (needs int8_delayed + an instance-family norm).
+    int8_stem: bool = False
+    int8_head: bool = False
+    int8_fused_epilogue: bool = False
     # Normalization on the inner (stage 1..n_layers) convs — the pix2pixHD
     # paper's D carries InstanceNorm there; this repo's reference lineage
     # (networks.py:716) has none, so "none" is the parity default.
@@ -174,39 +193,81 @@ class NLayerDiscriminator(nn.Module):
             raise ValueError(
                 f"discriminator norm must be none/instance/pallas_instance "
                 f"(stateless), got {self.norm!r}")
+        fused_q = (self.int8 and self.int8_delayed
+                   and self.int8_fused_epilogue)
+        if fused_q and self.norm not in ("instance", "pallas_instance"):
+            raise ValueError(
+                "int8_fused_epilogue needs a stateless instance-family "
+                f"discriminator norm (norm_d), got {self.norm!r}")
         feats = []
         nf = self.ndf
         na = (make_norm_act(self.norm, dtype=self.dtype)
               if self.norm != "none" else None)
-        y = _PlainConv(nf, stride=2, dtype=self.dtype)(x)
+        y = _PlainConv(nf, stride=2,
+                       int8=self.int8 and self.int8_stem,
+                       int8_delayed=self.int8_delayed,
+                       dtype=self.dtype)(x)
         y = leaky_relu_y(y, 0.2)
         feats.append(y)
 
-        def inner(y, features, stride):
+        def inner_conv(y, features, stride, ep=None, tap=False):
             if self.use_spectral_norm:
-                y = SpectralConv(
+                return SpectralConv(
                     features, kernel_size=4, stride=stride, padding=2,
                     int8=self.int8, int8_delayed=self.int8_delayed,
-                    dtype=self.dtype
+                    epilogue=ep, epilogue_tap=tap, dtype=self.dtype
                 )(y)
-            else:
-                y = _PlainConv(features, stride=stride, int8=self.int8,
-                               int8_delayed=self.int8_delayed,
-                               dtype=self.dtype)(y)
+            return _PlainConv(features, stride=stride, int8=self.int8,
+                              int8_delayed=self.int8_delayed,
+                              epilogue=ep, epilogue_tap=tap,
+                              dtype=self.dtype)(y)
+
+        def inner(y, features, stride):
+            y = inner_conv(y, features, stride)
             if na is not None:
                 return na(y, act="leaky", slope=0.2)
             return leaky_relu_y(y, 0.2)
 
+        widths = []
         for _ in range(1, self.n_layers):
             nf = min(nf * 2, 512)
-            y = inner(y, nf, stride=2)
+            widths.append((nf, 2))
+        nf = min(nf * 2, 512)
+        widths.append((nf, 1))
+
+        if not fused_q:
+            for features, stride in widths:
+                y = inner(y, features, stride)
+                feats.append(y)
+        else:
+            # quantize-fused epilogues: each inner conv after the first
+            # consumes the PREVIOUS conv's raw output through its fused
+            # [norm + LeakyReLU + clip/round + amax] input epilogue
+            # (ops/pallas/norm_act.py) — the float activation between
+            # inner stages is never materialized. Feature-matching taps
+            # become the dequantized surrogate sx·q: exactly the values
+            # the downstream conv contracts (QAT-faithful taps). Module
+            # construction order is identical to the unfused branch, so
+            # flax auto-naming — and the whole param/quant tree — is
+            # unchanged; only the LAST inner epilogue stays unfused (the
+            # logits head quantizes its own input).
+            ep = (lambda y_, sx: na(y_, act="leaky", slope=0.2,
+                                    quant_scale=sx))
+            raw = None
+            for features, stride in widths:
+                if raw is None:
+                    raw = inner_conv(y, features, stride)
+                else:
+                    raw, tap = inner_conv(raw, features, stride, ep=ep,
+                                          tap=True)
+                    feats.append(tap)
+            y = na(raw, act="leaky", slope=0.2)
             feats.append(y)
 
-        nf = min(nf * 2, 512)
-        y = inner(y, nf, stride=1)
-        feats.append(y)
-
-        y = _PlainConv(1, stride=1, dtype=self.dtype)(y)
+        y = _PlainConv(1, stride=1,
+                       int8=self.int8 and self.int8_head,
+                       int8_delayed=self.int8_delayed,
+                       dtype=self.dtype)(y)
         if self.use_sigmoid:
             y = nn.sigmoid(y)
         feats.append(y)
@@ -225,6 +286,9 @@ class MultiscaleDiscriminator(nn.Module):
     get_interm_feat: bool = True
     int8: bool = False
     int8_delayed: bool = False
+    int8_stem: bool = False
+    int8_head: bool = False
+    int8_fused_epilogue: bool = False
     norm: str = "none"
     dtype: Optional[jnp.dtype] = None
 
@@ -243,6 +307,9 @@ class MultiscaleDiscriminator(nn.Module):
                 get_interm_feat=self.get_interm_feat,
                 int8=self.int8,
                 int8_delayed=self.int8_delayed,
+                int8_stem=self.int8_stem,
+                int8_head=self.int8_head,
+                int8_fused_epilogue=self.int8_fused_epilogue,
                 norm=self.norm,
                 dtype=self.dtype,
                 name=f"scale{self.num_D - 1 - i}",

@@ -23,7 +23,11 @@ from p2p_tpu.models.patchgan import MultiscaleDiscriminator
 
 
 def define_C(cfg: ModelConfig, dtype=None) -> nn.Module:
-    return CompressionNetwork(dtype=dtype)
+    return CompressionNetwork(
+        int8=cfg.int8 and cfg.int8_compression,
+        int8_delayed=cfg.int8_delayed,
+        dtype=dtype,
+    )
 
 
 def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
@@ -50,6 +54,7 @@ def define_G(cfg: ModelConfig, dtype=None, remat=False) -> nn.Module:
             int8=int8_g and cfg.upsample_mode == "deconv",
             int8_decoder=cfg.int8_decoder,
             int8_delayed=delayed,
+            int8_stem=cfg.int8_stem,
             legacy_layout=cfg.legacy_layout,
             thin_head=cfg.thin_head,
             head_pallas=cfg.head_pallas,
@@ -100,6 +105,9 @@ def define_D(cfg: ModelConfig, dtype=None) -> nn.Module:
         get_interm_feat=cfg.get_interm_feat,
         int8=cfg.int8,
         int8_delayed=cfg.int8_delayed,
+        int8_stem=cfg.int8_stem,
+        int8_head=cfg.int8_head,
+        int8_fused_epilogue=cfg.int8_fused_epilogue,
         norm=cfg.norm_d,
         dtype=dtype,
     )

@@ -56,6 +56,12 @@ class UNetGenerator(nn.Module):
     int8: bool = False
     int8_decoder: bool = False
     int8_delayed: bool = False
+    # Extend int8 to the k4-s2 RGB stem (down0). Default off — the
+    # measured-rejected verdict: the 3-wide contraction leaves the MXU
+    # idle either way (the stem is HBM-bound; see the dated waiver at
+    # the down_conv site) — but the knob keeps the form measurable per
+    # chip/shape (BENCH_INT8_FULL does not flip it).
+    int8_stem: bool = False
     # Keep the (mathematically dead) conv biases in front of norm layers.
     # A per-channel bias immediately followed by a mean-subtracting norm
     # (BatchNorm OR InstanceNorm) is exactly cancelled in the forward
@@ -107,6 +113,8 @@ class UNetGenerator(nn.Module):
         def down_conv(y, features, name, int8=False, norm_after=False,
                       stem=False):
             bias = not norm_after
+            if stem and self.int8 and self.int8_stem:
+                int8 = True
             if int8:
                 from p2p_tpu.ops.int8 import QuantConv
 
@@ -127,6 +135,7 @@ class UNetGenerator(nn.Module):
                     use_bias=bias, dtype=self.dtype,
                     kernel_init=normal_init(), name=name,
                 )(y)
+            # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 measured-rejected: only the 3-ch stem (down0) reaches this line under delayed-int8 (encoder i>0 takes the QuantConv branch above); its k4·3-wide contraction leaves the MXU idle in ANY dtype — the conv is HBM-bound, int8 buys nothing and costs the quantize pass (rounds 2-5 doctrine). ModelConfig.int8_stem keeps the form measurable per chip.
             return save_conv_out(nn.Conv(
                 features, kernel_size=(4, 4), strides=(2, 2), padding=1,
                 use_bias=bias, dtype=self.dtype, kernel_init=normal_init(),
@@ -190,6 +199,7 @@ class UNetGenerator(nn.Module):
                 else:
                     # bias dropped when a norm follows (i>0): the norm's
                     # mean subtraction cancels it exactly (see legacy_layout)
+                    # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 measured-rejected: under delayed-int8 with int8_decoder only the IMAGE head (up0) reaches this line (i>0 takes QuantSubpixelDeconv above); the tanh-facing head is quality-critical AND HBM-bound (3 live output lanes) — it stays bf16 by doctrine, deliberately without a knob (ops/int8.py module docstring).
                     y = save_conv_out(nn.ConvTranspose(
                         f, kernel_size=(4, 4), strides=(2, 2),
                         padding="SAME", use_bias=not (normed and i > 0),

@@ -402,12 +402,20 @@ class KN2RowConv(nn.Module):
     Param tree ("kernel" HWIO + optional "bias") matches ``nn.Conv`` so
     checkpoints interchange with the plain path; callers name it
     ``Conv_0`` to mirror an anonymous inner ``nn.Conv``.
+
+    ``int8`` routes the tap decomposition through the s8×s8→s32 form
+    (ops/int8.py ``int8_kn2row_conv``: fwd + wgrad on the int8 MXU, the
+    tiny-contraction dgrad bf16 per the per-form dispatch table);
+    ``int8_delayed`` switches to the stored-scale variant (the caller
+    threads the 'quant' collection). Param tree unchanged either way.
     """
 
     features: int
     kernel_size: int
     padding: int
     use_bias: bool = True
+    int8: bool = False
+    int8_delayed: bool = False
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
 
@@ -417,7 +425,23 @@ class KN2RowConv(nn.Module):
         kernel = self.param("kernel", self.kernel_init,
                             (k, k, x.shape[-1], self.features), jnp.float32)
         dt = self.dtype or jnp.float32
-        y = kn2row_thin_conv(x.astype(dt), kernel.astype(dt), self.padding)
+        if self.int8 and self.int8_delayed:
+            from p2p_tpu.ops.int8 import _delayed_scale, int8_kn2row_conv_ds
+
+            sx, update = _delayed_scale(self, x)
+            # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 per-form dispatch: the kn2row backward's dgrad contracts over k²·O (16 lanes for the k4→1 head) — below one MXU tile, the int8 rate is unrealizable there; it stays bf16 on the dequantized surrogate while fwd+wgrad run s8×s8→s32 (ops/int8.py kn2row dispatch table; backward eqns attribute to this call site)
+            y, amax = int8_kn2row_conv_ds(
+                x.astype(dt), kernel.astype(dt), sx, self.padding)
+            update(amax)
+        elif self.int8:
+            from p2p_tpu.ops.int8 import int8_kn2row_conv
+
+            # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 per-form dispatch: see the delayed branch above — the kn2row dgrad stays bf16 by design
+            y = int8_kn2row_conv(x.astype(dt), kernel.astype(dt),
+                                 self.padding)
+        else:
+            y = kn2row_thin_conv(x.astype(dt), kernel.astype(dt),
+                                 self.padding)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,
                               (self.features,), jnp.float32)

@@ -312,6 +312,208 @@ def _int8_conv_ds_bwd(strides, padding, lhs_dilation, res, ct):
 int8_conv_ds.defvjp(_int8_conv_ds_fwd, _int8_conv_ds_bwd)
 
 
+# ------------------------------------------------------------- kn2row
+# int8 form of the kn2row tap decomposition (ops/conv.py
+# kn2row_thin_conv) — the thin-output heads (PatchGAN 512→1) where the
+# ONLY large-tensor traffic is the 1×1 tap matmul over x. Per-form
+# dispatch table (chained v5e microbenchmarks, the ops/int8.py
+# convention):
+#
+#   contraction                form              dtype   why
+#   ---------------------------------------------------------------------
+#   fwd    z = x @ w_taps      dot over C_in     int8    C_in wide (512),
+#                                                        the one full-rate
+#                                                        HBM pass over x —
+#                                                        2× MXU
+#   wgrad  dw = xᵀ · pz        dot over N·H·W    int8    contraction dim is
+#                                                        the whole spatial
+#                                                        extent; re-reads x
+#                                                        (int8 = half the
+#                                                        bytes) at 2× MXU
+#   dgrad  dx = pz @ ŵᵀ        dot over k²·O     bf16    contraction dim is
+#                                                        k²·O (= 16 for the
+#                                                        k4→1 head) — far
+#                                                        below one MXU tile;
+#                                                        the s8 rate is
+#                                                        unrealizable, bf16
+#                                                        on the dequantized
+#                                                        surrogate keeps the
+#                                                        exact-VJP law
+#
+# The backward is the hand-derived patches-of-dz form (ops/conv.py
+# thin_head_conv — pz = im2col(pad(dz, k−1)) holds every shifted dz view
+# both cotangents need), generalized to the zero-padded stride-1 case:
+# pz spans the PADDED input coordinates, dx crops the ring, dw reads the
+# int8-padded xq (zero padding is exact in int8).
+
+
+def _kn2row_i32(xq, wq, pad):
+    """Quantized tap decomposition: int32 tap matmul + int32 shift-adds.
+    xq (N,H,W,C) int8, wq (k,k,C,O) int8 → (N,H+2p−k+1,W+2p−k+1,O) int32.
+    The k² partial sums accumulate in int32 — rounding once at the dequant
+    exactly like the s32 conv accumulator it replaces."""
+    kh, kw, c, o = wq.shape
+    n, h, w, _ = xq.shape
+    ho, wo = h + 2 * pad - kh + 1, w + 2 * pad - kw + 1
+    wt = wq.reshape(kh * kw, c, o).transpose(1, 0, 2).reshape(
+        c, kh * kw * o)
+    z32 = jax.lax.dot_general(
+        xq, wt, (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).reshape(n, h, w, kh * kw, o)
+    z32 = jnp.pad(z32, ((0, 0), (pad, pad), (pad, pad), (0, 0), (0, 0)))
+    y32 = jnp.zeros((n, ho, wo, o), jnp.int32)
+    for t in range(kh * kw):
+        dh, dw = divmod(t, kw)
+        y32 = y32 + jax.lax.dynamic_slice(
+            z32, (0, dh, dw, t, 0), (n, ho, wo, 1, o)
+        ).reshape(n, ho, wo, o)
+    return y32
+
+
+def _kn2row_fwd_core(x, w, sx, pad, amax_from_x):
+    """Shared forward of the dynamic/delayed int8 kn2row pair. Returns
+    ``((y, amax), residuals)``; ``amax_from_x`` measures max|x| in the
+    same pass (the delayed-scale update proposal)."""
+    sx = jnp.maximum(jnp.asarray(sx, jnp.float32), 1e-12)
+    sw = absmax_scale(w, axis=(0, 1, 2))          # (1,1,1,O)
+    xf = x.astype(jnp.float32)
+    xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    amax = jnp.max(jnp.abs(xf)) if amax_from_x else jnp.zeros((), jnp.float32)
+    wq = quantize_int8(w, sw)
+    y32 = _kn2row_i32(xq, wq, pad)
+    y = y32.astype(jnp.float32) * (sx * sw.reshape(1, 1, 1, -1))
+    x_tok = jnp.zeros((0,), x.dtype)
+    w_tok = jnp.zeros((0,), w.dtype)
+    return (y.astype(x.dtype), amax), (xq, sx, wq, sw, x_tok, w_tok)
+
+
+def _int8_kn2row_bwd_core(pad, res, g):
+    """Patches-of-dz backward with the per-form dispatch above."""
+    xq, sx, wq, sw, x_tok, w_tok = res
+    from p2p_tpu.ops.conv import im2col_patches
+
+    k = wq.shape[0]
+    o = wq.shape[-1]
+    cin = wq.shape[2]
+    n, h, w_, _ = xq.shape
+    gf = g.astype(jnp.float32)
+    # pz[q, (kh',kw',o)] = dz[q − (k−1) + (kh',kw')] over PADDED x coords
+    dzp = jnp.pad(gf, ((0, 0), (k - 1, k - 1), (k - 1, k - 1), (0, 0)))
+    pz = im2col_patches(dzp.astype(jnp.bfloat16), k)   # (N,H+2p,W+2p,k²·O)
+    # ---- dgrad (bf16 — tiny k²·O contraction, dispatch table above) ----
+    w_hat = (wq.astype(jnp.float32) * sw).astype(jnp.bfloat16)
+    wd = jnp.flip(w_hat, (0, 1)).transpose(0, 1, 3, 2).reshape(
+        k * k * o, cin)
+    # bf16 by the dispatch table above — the coverage waiver lives at the
+    # custom-VJP CALL SITES (jax attributes backward eqns there), e.g.
+    # ops/conv.py KN2RowConv
+    dxp = jax.lax.dot_general(
+        pz, wd, (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dx = jax.lax.slice(
+        dxp, (0, pad, pad, 0), (n, pad + h, pad + w_, cin)
+    ).astype(x_tok.dtype)
+    # ---- wgrad (int8 — the big N·H·W contraction re-reading x) --------
+    xpq = jnp.pad(xq, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    spz = absmax_scale(pz)
+    pzq = quantize_int8(pz, spz)
+    dwm32 = jax.lax.dot_general(
+        xpq, pzq, (((0, 1, 2), (0, 1, 2)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                          # (C, k²·O) in (kh',kw',o)
+    dwm = dwm32.astype(jnp.float32) * (sx * spz)
+    dw = jnp.flip(dwm.reshape(cin, k, k, o), (1, 2)).transpose(1, 2, 0, 3)
+    return dx, dw.astype(w_tok.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def int8_kn2row_conv(x: jax.Array, w: jax.Array, pad: int):
+    """Stride-1 thin-output conv on the int8 kn2row path (dynamic
+    per-tensor activation scale). NHWC ⊛ HWIO, zero padding both sides."""
+    (y, _), _ = _kn2row_fwd_core(x, w, absmax_scale(x), pad, False)
+    return y
+
+
+def _int8_kn2row_fwd(x, w, pad):
+    (y, _), res = _kn2row_fwd_core(x, w, absmax_scale(x), pad, False)
+    return y, res
+
+
+def _int8_kn2row_bwd(pad, res, g):
+    return _int8_kn2row_bwd_core(pad, res, g)
+
+
+int8_kn2row_conv.defvjp(_int8_kn2row_fwd, _int8_kn2row_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def int8_kn2row_conv_ds(x: jax.Array, w: jax.Array, sx: jax.Array,
+                        pad: int):
+    """``int8_kn2row_conv`` with a STORED activation scale — returns
+    ``(y, amax_x)`` like :func:`int8_conv_ds` (same delayed-scale
+    contract; the cotangent-side scales stay dynamic)."""
+    out, _ = _kn2row_fwd_core(x, w, sx, pad, True)
+    return out
+
+
+def _int8_kn2row_ds_fwd(x, w, sx, pad):
+    return _kn2row_fwd_core(x, w, sx, pad, True)
+
+
+def _int8_kn2row_ds_bwd(pad, res, ct):
+    g, _ = ct  # the amax output feeds a state update, never a loss
+    dx, dw = _int8_kn2row_bwd_core(pad, res, g)
+    return dx, dw, jnp.zeros((), jnp.float32)
+
+
+int8_kn2row_conv_ds.defvjp(_int8_kn2row_ds_fwd, _int8_kn2row_ds_bwd)
+
+
+# ----------------------------------------------------- prequantized in
+# The consumer half of the quantize-fused epilogue
+# (ops/pallas/norm_act.py norm_act_quant): the producer kernel already
+# clipped/rounded the activation onto the int8 grid (values in
+# [-127,127], carried in the compute dtype so autodiff stays legal — an
+# int8-dtype output would surface float0 tangents and sever the chain),
+# so the conv's input quantize degenerates to a pure convert that fuses
+# into the conv's operand read. The returned input cotangent is w.r.t.
+# the DEQUANTIZED surrogate sx·q — the epilogue's straight-through
+# backward consumes it as d/dy directly, which composes to exactly the
+# unfused ``int8_conv_ds`` VJP law.
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def int8_conv_pq(xi: jax.Array, w: jax.Array, sx: jax.Array,
+                 strides: Tuple[int, int], padding: Pads,
+                 lhs_dilation: Tuple[int, int] = (1, 1)):
+    """``int8_conv_ds`` whose activation arrives ALREADY on the int8 grid
+    (integer values in [-127,127] in a float container, scale ``sx``)."""
+    y, _ = _int8_conv_pq_fwd(xi, w, sx, strides, padding, lhs_dilation)
+    return y
+
+
+def _int8_conv_pq_fwd(xi, w, sx, strides, padding, lhs_dilation):
+    sx = jnp.maximum(jnp.asarray(sx, jnp.float32), 1e-12)
+    sw = absmax_scale(w, axis=(0, 1, 2))
+    xq = xi.astype(jnp.int8)        # pure convert: values already on-grid
+    wq = quantize_int8(w, sw)
+    y32 = _conv_i32(xq, wq, strides, padding, lhs_dil=lhs_dilation)
+    y = y32.astype(jnp.float32) * (sx * sw.reshape(1, 1, 1, -1))
+    x_tok = jnp.zeros((0,), xi.dtype)
+    w_tok = jnp.zeros((0,), w.dtype)
+    return y.astype(xi.dtype), (xq, sx, wq, sw, x_tok, w_tok)
+
+
+def _int8_conv_pq_bwd(strides, padding, lhs_dilation, res, g):
+    dx, dw = _int8_bwd_core(strides, padding, lhs_dilation, res, g)
+    return dx, dw, jnp.zeros((), jnp.float32)
+
+
+int8_conv_pq.defvjp(_int8_conv_pq_fwd, _int8_conv_pq_bwd)
+
+
 # Decaying-max amax update: responds upward immediately (next step uses
 # the larger measured amax), decays 5%/step when activations shrink so a
 # one-off spike doesn't pin the scale forever.
@@ -381,6 +583,36 @@ def _norm_pair(v) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _fused_epilogue_scale(mod: nn.Module, x: jax.Array, ep: Callable):
+    """The quantize-fused-epilogue twin of :func:`_delayed_scale`, shared
+    by ``QuantConv`` and ``SpectralConv``: own the ``amax_x`` leaf (init
+    = the epilogue's measured amax on the init batch — the amax output
+    is scale-independent, so any positive probe works), read this step's
+    stored scale, run the fused ``(y_raw, sx) -> (q, amax)`` epilogue,
+    and store the update proposal when 'quant' is mutable. Returns
+    ``(q, sx)`` — feed :func:`int8_conv_pq`; the dequantized tap is
+    ``q·sx``."""
+    amax_v = mod.variable(
+        "quant", "amax_x",
+        lambda: ep(x, jnp.ones((), jnp.float32))[1],
+    )
+    sx = jnp.maximum(amax_v.value, 1e-12) / 127.0
+    q, amax = ep(x, sx)
+    if mod.is_mutable_collection("quant"):
+        amax_v.value = amax_update(amax, amax_v.value)
+    return q, sx
+
+
+def surrogate_tap(q: jax.Array, sx: jax.Array) -> jax.Array:
+    """The dequantized feature tap of a fused epilogue: VALUE ``sx·q``
+    (what the downstream conv contracts), but with the cotangent passed
+    to ``q`` UNSCALED — the fused-epilogue VJP already interprets q's
+    cotangent in the surrogate (d/dŷ) frame, and a plain ``q*sx`` would
+    multiply it by ``sx`` a second time (≈amax/127, silently
+    near-zeroing the feature-matching gradients through the tap)."""
+    return q + jax.lax.stop_gradient(q * sx - q)
+
+
 def _delayed_scale(mod: nn.Module, x: jax.Array):
     """Stored-scale plumbing shared by the Quant* modules: an ``amax_x``
     scalar in the 'quant' collection (initialized from the init batch),
@@ -407,6 +639,18 @@ class QuantConv(nn.Module):
     sides) or explicit ((lo,hi),(lo,hi)). ``delayed`` switches the
     activation scale to the stored-amax path (see int8_conv_ds): the
     'quant' collection must then be threaded by the caller.
+
+    ``epilogue`` (requires ``delayed``) is the quantize-fused input
+    epilogue (ISSUE 14): a callable ``(y_raw, sx) -> (q, amax)`` — the
+    model binds ``make_norm_act(...)``'s ``quant_scale`` form — applied
+    to the RAW previous-conv output so [norm + act + clip/round + amax]
+    run as one streaming pass; the conv then consumes the prequantized
+    activation via :func:`int8_conv_pq`. The stored scale IS this
+    module's own ``amax_x`` (same 'quant' leaf as the unfused path —
+    checkpoints interchange; its init measures the epilogue's float
+    output on the init batch). ``epilogue_tap=True`` additionally
+    returns the dequantized surrogate ``sx·q`` — what the downstream
+    conv actually sees — for feature-matching taps.
     """
 
     features: int
@@ -417,6 +661,8 @@ class QuantConv(nn.Module):
     dtype: Optional[jnp.dtype] = None
     kernel_init: Callable = normal_init()
     delayed: bool = False
+    epilogue: Optional[Callable] = None
+    epilogue_tap: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -428,12 +674,26 @@ class QuantConv(nn.Module):
         pad = self.padding
         pad = ((pad, pad), (pad, pad)) if isinstance(pad, int) else pad
         dt = self.dtype or jnp.float32
-        if self.delayed:
+        tap = None
+        if self.epilogue is not None:
+            if not self.delayed:
+                raise ValueError(
+                    "QuantConv(epilogue=...) needs delayed=True — the "
+                    "fused quantize reads this module's stored amax")
+            q, sx = _fused_epilogue_scale(self, x, self.epilogue)
+            # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 per-form dispatch (_int8_bwd_core): same bf16 backward forms as the int8_conv_ds branch below, by design
+            y = int8_conv_pq(q.astype(dt), kernel.astype(dt), sx,
+                             _norm_pair(self.strides), pad)
+            if self.epilogue_tap:
+                tap = surrogate_tap(q.astype(dt), sx).astype(dt)
+        elif self.delayed:
             sx, update = _delayed_scale(self, x)
+            # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 per-form dispatch (_int8_bwd_core): the lhs-dilated stride-2 dgrad and the transposed/big-spatial wgrads measured SLOWER in int8 on v5e — those contractions stay bf16 on the dequantized surrogate while fwd, s1 dgrad and the unrolled wgrad run s8×s8→s32 (module docstring table; backward eqns attribute to this call site)
             y, amax = int8_conv_ds(x.astype(dt), kernel.astype(dt), sx,
                                    _norm_pair(self.strides), pad)
             update(amax)
         else:
+            # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 per-form dispatch: see the delayed branch above — same _int8_bwd_core bf16 forms by design
             y = int8_conv(x.astype(dt), kernel.astype(dt),
                           _norm_pair(self.strides), pad)
         y = save_conv_out(y)
@@ -441,6 +701,8 @@ class QuantConv(nn.Module):
             bias = self.param("bias", nn.initializers.zeros,
                               (self.features,), jnp.float32)
             y = y + bias.astype(y.dtype)
+        if self.epilogue_tap:
+            return y, tap
         return y
 
 

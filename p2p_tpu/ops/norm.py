@@ -233,10 +233,23 @@ def make_norm_act(kind: str, *, train: bool = True,
     module per call, so flax auto-naming — and therefore param/stat trees —
     is identical to the unfused ``make_norm`` layout)."""
     if kind == "pallas_instance":
-        from p2p_tpu.ops.pallas.instance_norm import pallas_instance_norm_act
+        from p2p_tpu.ops.pallas.instance_norm import (
+            pallas_instance_norm_act,
+            pallas_instance_norm_act_quant,
+        )
 
         def apply_fused(y, act: str = "none", slope: float = 0.2,
-                        residual=None):
+                        residual=None, quant_scale=None):
+            if quant_scale is not None:
+                # quantize-fused epilogue (ISSUE 14): emit the on-grid
+                # activation + its amax proposal from the same two-pass
+                # kernel; the caller feeds ops.int8.int8_conv_pq
+                if residual is not None:
+                    raise ValueError(
+                        "quant_scale does not compose with residual "
+                        "(no quantized resblock tail in the zoo)")
+                return pallas_instance_norm_act_quant(
+                    y, quant_scale, act=act, slope=slope)
             out = pallas_instance_norm_act(y, residual=residual, act=act,
                                            slope=slope)
             return out.astype(dtype or y.dtype)
@@ -245,9 +258,21 @@ def make_norm_act(kind: str, *, train: bool = True,
 
     mk = make_norm(kind, train=train, axis_name=axis_name, dtype=dtype)
 
-    def apply_ref(y, act: str = "none", slope: float = 0.2, residual=None):
+    def apply_ref(y, act: str = "none", slope: float = 0.2, residual=None,
+                  quant_scale=None):
         from p2p_tpu.ops.activations import leaky_relu_y, relu_y
 
+        if quant_scale is not None:
+            if kind != "instance" or residual is not None:
+                raise ValueError(
+                    "quant_scale needs a stateless instance-family norm "
+                    f"with no residual (kind={kind!r})")
+            # the CPU/lax reference of the quantize-fused epilogue —
+            # same custom-VJP STE law as the kernel path
+            from p2p_tpu.ops.pallas.norm_act import instance_norm_act_quant
+
+            return instance_norm_act_quant(y, quant_scale, act=act,
+                                           slope=slope)
         z = mk()(y)
         if residual is not None:
             z = z + residual

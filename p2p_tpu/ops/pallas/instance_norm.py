@@ -228,6 +228,39 @@ def pallas_instance_norm_act(
                                    slope=slope, eps=eps, interpret=interp)
 
 
+def pallas_instance_norm_act_quant(
+    x: jax.Array,
+    sx: jax.Array,
+    scale: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    act: str = "none",
+    slope: float = 0.2,
+    eps: float = 1e-5,
+    force_pallas: bool = False,
+    interpret: bool = False,
+):
+    """The QUANTIZE-fused epilogue dispatch (ISSUE 14 bandwidth half):
+    ``act(norm(x)·γ+β)`` clipped/rounded onto the int8 grid with stored
+    scale ``sx`` → ``(q, amax)``, all in one two-pass streaming kernel
+    (ops/pallas/norm_act.py ``instance_norm_act_quant``). Same seam
+    shape as :func:`pallas_instance_norm_act`: TPU backends (or
+    ``P2P_TPU_FORCE_PALLAS=1``) run the Pallas kernel, everywhere else
+    the lax reference runs through the SAME custom-VJP STE law — CPU
+    tier-1 exercises the identical call sites and backward. Spatially
+    sharded shards fall back to the reference (the quant kernel has no
+    shard_map variant yet — the D families this epilogue serves are not
+    spatial-sharded)."""
+    from p2p_tpu.ops.pallas.norm_act import instance_norm_act_quant
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    force_pallas = force_pallas or os.environ.get(
+        "P2P_TPU_FORCE_PALLAS") == "1"
+    use_kernel = (on_tpu or force_pallas) and _sharding_mesh_for(x) is None
+    return instance_norm_act_quant(
+        x, sx, scale, bias, act=act, slope=slope, eps=eps,
+        use_kernel=use_kernel, interpret=interpret or not on_tpu)
+
+
 class PallasInstanceNorm(nn.Module):
     """Module wrapper matching :class:`p2p_tpu.ops.norm.InstanceNorm`."""
 

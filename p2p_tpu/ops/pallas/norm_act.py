@@ -207,3 +207,190 @@ def instance_norm_act_fused_sharded(x, scale=None, bias=None, residual=None,
     _check_act(act, slope)
     return _in_act_fused(x, scale, bias, residual, act, slope, eps,
                          interpret, axis_name)
+
+
+# ----------------------------------------------------- quantize-fused
+# ISSUE 14, the bandwidth half: when the conv that CONSUMES a norm+act
+# epilogue runs on the delayed-int8 path, the activation's clip/round
+# quantize is one more elementwise pass XLA cannot fuse into the
+# pallas_call producer — a full-size read+write the newly quantized
+# layer would pay on top of the epilogue. This variant folds [normalize
+# · affine · activation · clip/round quantize · amax measurement] into
+# the SAME two-pass streaming kernel: the conv output is still read
+# exactly twice (stats, normalize) and written once — but what is
+# written is the activation already on the int8 grid, plus per-block
+# amax partials (the delayed-scale update proposal) reduced outside on
+# the tiny tile tensor.
+#
+# The quantized activation is carried in the COMPUTE dtype (bf16/f32)
+# holding exact integer values in [-127, 127]: an int8-dtype output
+# would surface float0 tangents at the op boundary and sever autodiff —
+# the consumer (ops/int8.py ``int8_conv_pq``) converts to int8 in its
+# operand read, a pure elementwise cast. The activation value is rounded
+# THROUGH the compute dtype before the quantize (y.astype(x.dtype)) so
+# the fused path is bitwise-equal to [unfused epilogue → int8_conv_ds].
+#
+# Backward mirrors the existing delayed-int8 STE law (ops/int8.py): the
+# incoming cotangent is w.r.t. the dequantized surrogate sx·q and passes
+# straight through clip/round; the activation mask is recomputed from
+# the pre-activation (x, mean, rstd and the affine are residuals — the
+# quantized output cannot mask: round() kills the sign information near
+# zero), then the standard instance-norm VJP. ``sx`` is state (a stored
+# amax), so its cotangent is zero, exactly like ``int8_conv_ds``.
+
+
+def _norm_act_quant_kernel(x_ref, mean_ref, rstd_ref, scale_ref, bias_ref,
+                           sx_ref, y_ref, am_ref, *, act: str, slope: float):
+    x = x_ref[...].astype(jnp.float32)
+    y = (x - mean_ref[...]) * rstd_ref[...]
+    y = y * scale_ref[...] + bias_ref[...]
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    elif act == "leaky":
+        y = jnp.where(y >= 0.0, y, slope * y)
+    # round through the activation dtype FIRST — bitwise what the
+    # unfused [epilogue module → int8_conv_ds] chain quantizes
+    yc = y.astype(y_ref.dtype).astype(jnp.float32)
+    q = jnp.clip(jnp.round(yc / sx_ref[...]), -127.0, 127.0)
+    y_ref[...] = q.astype(y_ref.dtype)
+    am_ref[0, 0] = jnp.max(jnp.abs(yc))
+
+
+def _norm_act_quant_local(x, mean, rstd, scale, bias, sx, act, slope,
+                          interpret):
+    """Pass 2 with the quantize-fused epilogue: emits the on-grid
+    activation (compute dtype) AND the per-block amax partials."""
+    n, h, w, c = x.shape
+    hb = _pick_h_block(h, w, c)
+    x_spec = pl.BlockSpec((1, hb, w, c), lambda i, j: (i, j, 0, 0))
+    cvec_spec = pl.BlockSpec((1, 1, 1, c), lambda i, j: (i, 0, 0, 0))
+    bcast_spec = pl.BlockSpec((1, 1, 1, c), lambda i, j: (0, 0, 0, 0))
+    am_spec = pl.BlockSpec((1, 1), lambda i, j: (i, j))
+    if scale is None:
+        scale_t = jnp.ones((1, 1, 1, c), jnp.float32)
+        bias_t = jnp.zeros((1, 1, 1, c), jnp.float32)
+    else:
+        scale_t = scale.reshape(1, 1, 1, c).astype(jnp.float32)
+        bias_t = bias.reshape(1, 1, 1, c).astype(jnp.float32)
+    sx_t = jnp.asarray(sx, jnp.float32).reshape(1, 1, 1, 1)
+    kern = functools.partial(_norm_act_quant_kernel, act=act, slope=slope)
+    yq, am = pl.pallas_call(
+        kern,
+        grid=(n, h // hb),
+        in_specs=[x_spec, cvec_spec, cvec_spec, bcast_spec, bcast_spec,
+                  pl.BlockSpec((1, 1, 1, 1), lambda i, j: (0, 0, 0, 0))],
+        out_specs=[x_spec, am_spec],
+        out_shape=[jax.ShapeDtypeStruct(x.shape, x.dtype),
+                   jax.ShapeDtypeStruct((n, h // hb), jnp.float32)],
+        interpret=interpret,
+    )(x, mean, rstd, scale_t, bias_t, sx_t)
+    return yq, jnp.max(am)
+
+
+def _quant_fwd_impl(x, scale, bias, sx, act, slope, eps, use_kernel,
+                    interpret):
+    sx = jnp.maximum(jnp.asarray(sx, jnp.float32), 1e-12)
+    if use_kernel:
+        n, h, w, c = x.shape
+        s1, s2 = _stats_local(x, interpret)
+        count = jnp.float32(h * w)
+        mean = s1 / count
+        var = jnp.maximum(s2 / count - mean * mean, 0.0)
+        rstd = jax.lax.rsqrt(var + eps)
+        yq, amax = _norm_act_quant_local(x, mean, rstd, scale, bias, sx,
+                                         act, slope, interpret)
+        return yq, amax, mean, rstd, count
+    # the lax reference — same op order as the unfused CPU chain
+    # (instance_norm._xla_instance_norm_act → quantize): jnp moments,
+    # normalize, affine, activation, cast to the activation dtype, THEN
+    # clip/round — bitwise what [make_norm_act → int8_conv_ds] computes
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+    var = jnp.var(x32, axis=(1, 2), keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (x32 - mean) * rstd
+    if scale is not None:
+        y = y * scale.reshape(1, 1, 1, -1) + bias.reshape(1, 1, 1, -1)
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    elif act == "leaky":
+        y = jnp.where(y >= 0.0, y, slope * y)
+    yc = y.astype(x.dtype).astype(jnp.float32)
+    yq = jnp.clip(jnp.round(yc / sx), -127.0, 127.0).astype(x.dtype)
+    amax = jnp.max(jnp.abs(yc))
+    count = jnp.float32(x.shape[1] * x.shape[2])
+    return yq, amax, mean, rstd, count
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _in_act_quant(x, scale, bias, sx, act, slope, eps, use_kernel,
+                  interpret):
+    yq, amax, _, _, _ = _quant_fwd_impl(x, scale, bias, sx, act, slope,
+                                        eps, use_kernel, interpret)
+    return yq, amax
+
+
+def _in_act_quant_fwd(x, scale, bias, sx, act, slope, eps, use_kernel,
+                      interpret):
+    yq, amax, mean, rstd, count = _quant_fwd_impl(
+        x, scale, bias, sx, act, slope, eps, use_kernel, interpret)
+    return (yq, amax), (x, scale, bias, mean, rstd, count)
+
+
+def _in_act_quant_bwd(act, slope, eps, use_kernel, interpret, res, ct):
+    g, _ = ct  # the amax output feeds a state update, never a loss
+    x, scale, bias, mean, rstd, count = res
+    # STE through clip/round: the incoming cotangent is w.r.t. the
+    # dequantized surrogate sx·q ≈ y and passes through unchanged — the
+    # composition with int8_conv_pq's surrogate-cotangent convention IS
+    # the unfused int8_conv_ds VJP law.
+    g32 = g.astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    xhat = (x32 - mean) * rstd
+    gamma = (
+        jnp.float32(1.0) if scale is None
+        else scale.reshape(1, 1, 1, -1).astype(jnp.float32)
+    )
+    beta = (
+        jnp.float32(0.0) if bias is None
+        else bias.reshape(1, 1, 1, -1).astype(jnp.float32)
+    )
+    # activation mask from the recomputed PRE-activation (the saved
+    # output is quantized — round() erases the sign near zero); for the
+    # sign-preserving acts this is the same mask the output-based law
+    # (ops/activations.py) computes: y > 0 ⇔ h > 0, y ≥ 0 ⇔ h ≥ 0
+    h = xhat * gamma + beta
+    if act == "relu":
+        g32 = jnp.where(h > 0, g32, 0.0)
+    elif act == "leaky":
+        g32 = jnp.where(h >= 0, g32, slope * g32)
+    dxhat = g32 * gamma
+    m1 = jnp.sum(dxhat, axis=(1, 2), keepdims=True) / count
+    m2 = jnp.sum(dxhat * xhat, axis=(1, 2), keepdims=True) / count
+    dx = (rstd * (dxhat - m1 - xhat * m2)).astype(x.dtype)
+    if scale is None:
+        dscale = dbias = None
+    else:
+        dscale = jnp.sum(g32 * xhat, axis=(0, 1, 2)).astype(scale.dtype)
+        dbias = jnp.sum(g32, axis=(0, 1, 2)).astype(bias.dtype)
+    # sx is state (a stored amax), not a trained parameter
+    return dx, dscale, dbias, jnp.zeros((), jnp.float32)
+
+
+_in_act_quant.defvjp(_in_act_quant_fwd, _in_act_quant_bwd)
+
+
+def instance_norm_act_quant(x, sx, scale=None, bias=None,
+                            act: str = "none", slope: float = 0.2,
+                            eps: float = 1e-5, use_kernel: bool = False,
+                            interpret: bool = False):
+    """Quantize-fused ``act(instance_norm(x)·γ+β)`` → ``(q, amax)``:
+    the activation clipped/rounded onto the int8 grid with stored scale
+    ``sx`` (values in [-127,127], carried in ``x.dtype``) plus the max
+    |activation| measured in the same pass. ``use_kernel`` selects the
+    Pallas two-pass kernel (``interpret=True`` off-TPU); otherwise the
+    lax reference with the SAME custom-VJP STE law. Feed ``q`` to
+    ``ops.int8.int8_conv_pq`` with the same ``sx``."""
+    _check_act(act, slope)
+    return _in_act_quant(x, scale, bias, sx, act, slope, eps, use_kernel,
+                         interpret)

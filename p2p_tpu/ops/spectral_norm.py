@@ -79,6 +79,13 @@ class SpectralConv(nn.Module):
     # stored-scale activation quantization (ops/int8.py int8_conv_ds);
     # requires the caller to thread the 'quant' collection.
     int8_delayed: bool = False
+    # quantize-fused input epilogue (ISSUE 14, ops/int8.py QuantConv
+    # docstring): (y_raw, sx) -> (q, amax); requires int8 + int8_delayed.
+    # Composes with spectral norm unchanged — the power iteration still
+    # tracks the true f32 weight, only w/σ meets the prequantized
+    # activation in the s8×s8→s32 contraction.
+    epilogue: Optional[Callable] = None
+    epilogue_tap: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -104,12 +111,35 @@ class SpectralConv(nn.Module):
         kernel_sn = (kernel / sigma).astype(self.dtype or x.dtype)
 
         pad = self.padding
+        tap = None
         if self.int8:
             p = ((pad, pad), (pad, pad))
-            if self.int8_delayed:
+            if self.epilogue is not None:
+                if not self.int8_delayed:
+                    raise ValueError(
+                        "SpectralConv(epilogue=...) needs int8_delayed — "
+                        "the fused quantize reads the stored amax")
+                from p2p_tpu.ops.int8 import (
+                    _fused_epilogue_scale,
+                    int8_conv_pq,
+                    surrogate_tap,
+                )
+
+                q, sx = _fused_epilogue_scale(self, x, self.epilogue)
+                # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 per-form dispatch (_int8_bwd_core): the lhs-dilated stride-2 dgrad and transposed/big-spatial wgrads stay bf16 by the measured dispatch table (ops/int8.py; backward eqns attribute to this call site)
+                y = int8_conv_pq(
+                    q.astype(kernel_sn.dtype), kernel_sn, sx,
+                    (self.stride, self.stride), p,
+                )
+                if self.epilogue_tap:
+                    tap = surrogate_tap(
+                        q.astype(kernel_sn.dtype), sx
+                    ).astype(kernel_sn.dtype)
+            elif self.int8_delayed:
                 from p2p_tpu.ops.int8 import _delayed_scale, int8_conv_ds
 
                 sx, update = _delayed_scale(self, x)
+                # p2p-lint: disable=perf-int8-coverage-gap -- 2026-08-04 per-form dispatch (_int8_bwd_core): the lhs-dilated stride-2 dgrad and transposed/big-spatial wgrads stay bf16 by the measured dispatch table (ops/int8.py; backward eqns attribute to this call site)
                 y, amax = int8_conv_ds(
                     x.astype(kernel_sn.dtype), kernel_sn, sx,
                     (self.stride, self.stride), p,
@@ -137,4 +167,7 @@ class SpectralConv(nn.Module):
                 "bias", nn.initializers.zeros, (self.features,), jnp.float32
             )
             y = y + bias.astype(y.dtype)
-        return save_conv_out(y)
+        y = save_conv_out(y)
+        if self.epilogue_tap:
+            return y, tap
+        return y

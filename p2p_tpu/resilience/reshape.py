@@ -415,6 +415,42 @@ def apply_batch_rebase(tr, step: int, aux, plan: ElasticPlan,
     return done, int(new_step)
 
 
+def arm_quant_init_warmup(tr, step: int) -> None:
+    """ISSUE 14 forward-compat: the restore just INITIALIZED quant amax
+    leaves a pre-drain checkpoint did not carry
+    (``CheckpointManager.last_restore_initialized_quant`` — new QuantConv
+    sites, the kn2row head, a whole ``quant_c``). Log the graft and arm
+    the ``--recalibrate_steps`` frozen-scale warmup over the CURRENT
+    (mixed restored+initialized) collections, reusing the
+    ``tp_amax_recalibrate`` freeze machinery: the init-batch scales are
+    exactly how a fresh run starts, and the warmup keeps every scale
+    pinned while the new sites' first real amax measurements land."""
+    initialized = list(
+        getattr(tr.ckpt, "last_restore_initialized_quant", []) or [])
+    if not initialized:
+        return
+    freeze = int(getattr(tr.cfg.train, "recalibrate_steps", 0) or 0)
+    tr.logger.log(
+        {"kind": "quant_init", "step": int(step),
+         "initialized_leaves": len(initialized),
+         "paths": initialized[:16],
+         "recalibrate_steps": freeze},
+        force=True,
+    )
+    if freeze <= 0:
+        return
+    amax_trees = {f: getattr(tr.state, f, None)
+                  for f in ("quant_g", "quant_d", "quant_c")}
+    pp_stages = getattr(tr.state, "pp_stages", None)
+    if isinstance(pp_stages, dict) and "quant" in pp_stages:
+        amax_trees["pp_quant"] = pp_stages["quant"]
+    tr._quant_freeze_remaining = freeze
+    tr._quant_frozen = {
+        f: jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), tree)
+        for f, tree in amax_trees.items() if tree}
+
+
 def hold_frozen_quant(tr) -> None:
     """The ``--recalibrate_steps`` warmup: while the window is open,
     re-pin the quant collections to their migrated values after each

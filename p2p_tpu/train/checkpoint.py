@@ -42,7 +42,7 @@ from __future__ import annotations
 import json
 import os
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -159,6 +159,117 @@ def _leaf_checksums(tree: Any) -> Optional[Dict[str, Dict[str, Any]]]:
     return out
 
 
+# --------------------------------------------------------- quant compat
+# Forward-compatible restore for GROWING 'quant' collections (ISSUE 14):
+# a pre-drain checkpoint is missing the amax leaves the widened int8
+# coverage added (new QuantConv sites, the kn2row head, quant_c as a
+# whole). Restoring it through a new-config template would be an Orbax
+# structure error; instead restore() intersects the template's quant
+# trees with the checkpoint's actual structure (item_metadata — no array
+# reads), restores what exists, and GRAFTS the template's init values
+# onto the missing leaves. The trainer then arms the --recalibrate_steps
+# frozen-scale warmup over the mixed collections
+# (resilience/reshape.arm_quant_init_warmup) — init-batch scales are
+# exactly how a fresh run starts, so the warmup semantics carry over.
+
+_QUANT_FIELDS = ("quant_g", "quant_d", "quant_c")
+
+
+class _QuantUnreconcilable(Exception):
+    """Checkpoint quant structure is not a subset of the template's
+    (e.g. a DOWNGRADE: more leaves on disk than in the config) — fall
+    back to the plain restore and its loud structure error."""
+
+
+def _quant_leaf_paths(tree, prefix=()) -> List[Tuple[str, ...]]:
+    out: List[Tuple[str, ...]] = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.extend(_quant_leaf_paths(tree[k], prefix + (str(k),)))
+    elif tree is not None:
+        out.append(prefix)
+    return out
+
+
+def _shape_to_saved(tmpl, saved, path, missing):
+    """Template subtree reshaped to the SAVED structure; template leaves
+    absent on disk are dropped and recorded in ``missing``."""
+    if saved is None:
+        missing.extend(_quant_leaf_paths(tmpl, path))
+        return None
+    if not isinstance(saved, dict):
+        if isinstance(tmpl, dict) or tmpl is None:
+            raise _QuantUnreconcilable(path)
+        return tmpl
+    if not isinstance(tmpl, dict):
+        raise _QuantUnreconcilable(path)
+    out = {}
+    for k, sv in saved.items():
+        if k not in tmpl:
+            raise _QuantUnreconcilable(path + (str(k),))
+        out[k] = _shape_to_saved(tmpl[k], sv, path + (str(k),), missing)
+    for k, tv in tmpl.items():
+        if k not in saved:
+            missing.extend(_quant_leaf_paths(tv, path + (str(k),)))
+    return out
+
+
+def _graft_union(restored, tmpl):
+    """Union of a restored (pruned) quant tree with the template — the
+    missing leaves take the template's (init) values."""
+    if restored is None:
+        return tmpl
+    if not isinstance(tmpl, dict) or not isinstance(restored, dict):
+        return restored
+    out = dict(restored)
+    for k, v in tmpl.items():
+        out[k] = _graft_union(out.get(k), v) if k in out else v
+    return out
+
+
+def reconcile_quant_template(template, shardings, saved_meta):
+    """``(template', shardings', missing)``: the restore template with
+    quant leaves absent from the checkpoint pruned (shardings pruned
+    identically), plus the missing leaf paths for the post-restore
+    graft. Covers ``quant_g/quant_d/quant_c`` and the PP-stacked trunk's
+    ``pp_stages['quant']``. Raises :class:`_QuantUnreconcilable` when
+    the checkpoint's quant structure is not a template subset."""
+    missing: List[Tuple[str, ...]] = []
+    t_upd, s_upd = {}, {}
+    for f in _QUANT_FIELDS:
+        t_upd[f] = _shape_to_saved(getattr(template, f, None),
+                                   saved_meta.get(f), (f,), missing)
+        if shardings is not None:
+            s_upd[f] = _shape_to_saved(getattr(shardings, f, None),
+                                       saved_meta.get(f), (f,), [])
+    tmpl_pp = getattr(template, "pp_stages", None)
+    saved_pp = saved_meta.get("pp_stages")
+    if (isinstance(tmpl_pp, dict) and "quant" in tmpl_pp
+            and isinstance(saved_pp, dict)):
+        t_upd["pp_stages"] = {
+            **tmpl_pp,
+            "quant": _shape_to_saved(tmpl_pp.get("quant"),
+                                     saved_pp.get("quant"),
+                                     ("pp_stages", "quant"), missing),
+        }
+        sh_pp = getattr(shardings, "pp_stages", None) \
+            if shardings is not None else None
+        if isinstance(sh_pp, dict) and "quant" in sh_pp:
+            s_upd["pp_stages"] = {
+                **sh_pp,
+                "quant": _shape_to_saved(sh_pp.get("quant"),
+                                         saved_pp.get("quant"),
+                                         ("pp_stages", "quant"), []),
+            }
+    if not missing:
+        return template, shardings, []
+    template = template.replace(**t_upd)
+    if shardings is not None and s_upd:
+        shardings = shardings.replace(**s_upd) \
+            if hasattr(shardings, "replace") else shardings
+    return template, shardings, missing
+
+
 def _restore_arg(abstract_leaf):
     """ArrayRestoreArgs carrying the template's dtype (Orbax casts, which
     is what full restore does too) and sharding when the template names
@@ -195,6 +306,11 @@ class CheckpointManager:
         # one; callers doing step bookkeeping (resume position, rollback
         # target) must read this, not the step they asked for
         self.last_restored_step: Optional[int] = None
+        # quant amax leaf paths the last restore() INITIALIZED from the
+        # template because the (pre-drain) checkpoint did not carry them
+        # — the trainer arms the frozen-scale warmup off this
+        # (resilience/reshape.arm_quant_init_warmup)
+        self.last_restore_initialized_quant: List[str] = []
 
     def _reg(self):
         if self._registry is None:
@@ -239,6 +355,26 @@ class CheckpointManager:
                 f"{int(step)}.integrity.json",
                 {"step": int(step), "algo": "crc32", "leaves": sums})
 
+    def _saved_structure(self, step: int) -> Optional[Dict[str, Any]]:
+        """The saved tree's STRUCTURE (field-name dict of nested dicts /
+        array metadata, no array reads) for the quant-compat
+        reconciliation. Goes through a ``PyTreeCheckpointer`` aimed at
+        the step's item directory — the manager's own ``item_metadata``
+        only works after a same-process save registered the handler.
+        Best-effort: None (unreadable/absent) disables reconciliation
+        for the step, restoring the plain structure-error behavior."""
+        item_dir = os.path.join(str(self._mgr.directory), str(step),
+                                "default")
+        if not os.path.isdir(item_dir):
+            return None
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                meta = ckptr.metadata(item_dir)
+            meta = getattr(meta, "tree", meta)
+            return meta if isinstance(meta, dict) else None
+        except Exception:
+            return None
+
     def restore(self, state_template: TrainState,
                 step: Optional[int] = None, verify: bool = True,
                 fallback: Optional[bool] = None, shardings=None):
@@ -279,23 +415,44 @@ class CheckpointManager:
             steps = steps[-1:]
         if not steps:
             raise FileNotFoundError("no checkpoint found")
-        if shardings is not None:
-            abstract = jax.tree_util.tree_map(
-                lambda leaf, sh: jax.ShapeDtypeStruct(
-                    np.shape(leaf) if not hasattr(leaf, "shape")
-                    else leaf.shape,
-                    getattr(leaf, "dtype", np.asarray(leaf).dtype),
-                    sharding=sh),
-                state_template, shardings)
-        else:
-            abstract = jax.tree_util.tree_map(
-                ocp.utils.to_shape_dtype_struct, state_template)
+
+        def build_abstract(tmpl, shards):
+            if shards is not None:
+                return jax.tree_util.tree_map(
+                    lambda leaf, sh: jax.ShapeDtypeStruct(
+                        np.shape(leaf) if not hasattr(leaf, "shape")
+                        else leaf.shape,
+                        getattr(leaf, "dtype", np.asarray(leaf).dtype),
+                        sharding=sh),
+                    tmpl, shards)
+            return jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, tmpl)
+
         tried: List[int] = []
         last_exc: Optional[BaseException] = None
+        self.last_restore_initialized_quant = []
         for s in reversed(steps):
             tried.append(s)
+            # forward-compat quant reconciliation (module comment above):
+            # intersect the template's quant trees with THIS step's saved
+            # structure; missing leaves restore from the template's init
+            # values after the read. Metadata failures (or genuinely
+            # unreconcilable structures) fall back to the plain template
+            # — and the plain structure error, which stays the loud
+            # failure for every non-quant mismatch.
+            tmpl_s, shards_s = state_template, shardings
+            missing: List[Tuple[str, ...]] = []
+            meta = self._saved_structure(s)
+            if isinstance(meta, dict):
+                try:
+                    tmpl_s, shards_s, missing = reconcile_quant_template(
+                        state_template, shardings, meta)
+                except _QuantUnreconcilable:
+                    tmpl_s, shards_s, missing = (state_template,
+                                                 shardings, [])
+            abstract = build_abstract(tmpl_s, shards_s)
 
-            def _restore(s=s):
+            def _restore(s=s, abstract=abstract):
                 chaos_point("ckpt_restore", step=s)
                 return self._mgr.restore(
                     s, args=ocp.args.StandardRestore(abstract))
@@ -321,6 +478,29 @@ class CheckpointManager:
                         + ("..." if len(bad) > 3 else ""))
                     continue
             self.last_restored_step = s
+            if missing:
+                # graft the template's init values onto the amax leaves
+                # this (pre-drain) checkpoint does not carry; the caller
+                # reads last_restore_initialized_quant and arms the
+                # --recalibrate_steps frozen-scale warmup
+                updates = {
+                    f: _graft_union(getattr(restored, f),
+                                    getattr(state_template, f))
+                    for f in _QUANT_FIELDS
+                }
+                if (isinstance(getattr(restored, "pp_stages", None), dict)
+                        and isinstance(state_template.pp_stages, dict)
+                        and "quant" in state_template.pp_stages):
+                    updates["pp_stages"] = {
+                        **restored.pp_stages,
+                        "quant": _graft_union(
+                            restored.pp_stages.get("quant"),
+                            state_template.pp_stages["quant"]),
+                    }
+                restored = restored.replace(**updates)
+                self.last_restore_initialized_quant = [
+                    "/".join(p) for p in missing]
+                self._reg().counter("quant_init_total").inc(len(missing))
             if shardings is not None:
                 # counted only on SUCCESS — the audit counter must name
                 # resharded restores that happened, not ones attempted

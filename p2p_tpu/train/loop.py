@@ -652,6 +652,11 @@ def perform_rollback(tr) -> None:
     # position/step bookkeeping must follow the weights actually restored
     if tr.ckpt.last_restored_step is not None:
         target = tr.ckpt.last_restored_step
+    # a rollback can land on a PRE-drain checkpoint missing newer amax
+    # leaves — same graft + warmup as the resume path (ISSUE 14)
+    from p2p_tpu.resilience.reshape import arm_quant_init_warmup
+
+    arm_quant_init_warmup(tr, int(target))
     done, mid = divmod(int(target), tr.steps_per_epoch)
     aux = tr.ckpt.restore_aux(int(target))
     if aux is not None and aux.get("batches_done") is not None:
@@ -1123,6 +1128,12 @@ class Trainer:
             step = self.ckpt.last_restored_step
             aux = self.ckpt.restore_aux(int(step))
         finish_elastic_restore(self, int(step), plan)
+        # forward-compat quant graft (ISSUE 14): a pre-drain checkpoint
+        # missing the widened coverage's amax leaves restored with those
+        # leaves initialized — arm the frozen-scale warmup over them
+        from p2p_tpu.resilience.reshape import arm_quant_init_warmup
+
+        arm_quant_init_warmup(self, int(step))
         # Exact-step resume: a mid-epoch (preemption) checkpoint re-enters
         # its epoch at batch `mid` — the loader skips exactly the batches
         # the killed run consumed (same shuffle: the epoch seed is a pure

@@ -82,6 +82,10 @@ class InferState(struct.PyTreeNode):
     # delayed-int8 stored activation scales; in eval mode the 'quant'
     # collection is read-only, so these act as FROZEN inference scales
     quant_g: Any = None
+    # net_c's stored scales (ModelConfig.int8_compression) — frozen at
+    # serve time exactly like quant_g; None when the preset has no
+    # quantized compression net (empty subtree, restore-compatible)
+    quant_c: Any = None
     # EMA generator params, restored when the checkpoint carries them
     # (HealthConfig.ema_decay) — the serving engine swaps them in for
     # params_g (ProGAN-lineage: serve the smoothed generator)
@@ -108,13 +112,15 @@ def create_infer_state(
     x = ingest(jnp.asarray(sample_batch["input"]))
     vg = init_variables(g, kg, x, cfg.model.init_type, cfg.model.init_gain,
                         train=False)
-    params_c = batch_stats_c = None
+    params_c = batch_stats_c = quant_c = None
+    delayed = cfg.model.int8_delayed
     if c is not None:
         vc = init_variables(c, kc, x, cfg.model.init_type, cfg.model.init_gain,
                             train=False)
         params_c = vc["params"]
         batch_stats_c = vc.get("batch_stats", {})
-    delayed = cfg.model.int8_delayed
+        if delayed and cfg.model.int8_compression:
+            quant_c = vc.get("quant", {})
     return InferState(
         step=jnp.zeros((), jnp.int32),
         params_g=vg["params"],
@@ -122,6 +128,7 @@ def create_infer_state(
         params_c=params_c,
         batch_stats_c=batch_stats_c,
         quant_g=vg.get("quant", {}) if delayed else None,
+        quant_c=quant_c,
         # with EMA on, the template names ema_g so restore_subtree reads
         # the smoothed weights from disk too (same tree as params_g)
         ema_g=(jax.tree_util.tree_map(jnp.copy, vg["params"])
@@ -139,6 +146,7 @@ def infer_state_from_train(state: "TrainState") -> InferState:
         params_c=state.params_c,
         batch_stats_c=state.batch_stats_c,
         quant_g=state.quant_g,
+        quant_c=state.quant_c,
         ema_g=state.ema_g,
     )
 
@@ -372,6 +380,9 @@ def create_train_state(
         pool_n = jnp.zeros((), jnp.int32)
 
     delayed = cfg.model.int8_delayed
+    quant_c = None
+    if c is not None and delayed and cfg.model.int8_compression:
+        quant_c = vc.get("quant", {})
     # EMA generator (HealthConfig.ema_decay): seeded with the init params
     # so step 1's blend is well-defined; decay=0 keeps ema == params
     # bitwise (the parity-pin mode), decay->1 smooths
@@ -393,6 +404,6 @@ def create_train_state(
         pool_n=pool_n,
         quant_g=vg.get("quant", {}) if delayed else None,
         quant_d=vd.get("quant", {}) if delayed else None,
-        quant_c=None,
+        quant_c=quant_c,
         ema_g=ema_g,
     )

@@ -220,6 +220,9 @@ def build_train_step(
     bits = cfg.model.quant_bits
     quant = quantize_ste if cfg.model.quant_ste else quantize
     use_c = cfg.model.use_compression_net
+    # net_c on the delayed-int8 path stores its amax as quant_c
+    use_qc = (use_c and cfg.model.int8_delayed
+              and cfg.model.int8_compression)
     need_vgg = (L.lambda_vgg > 0) and vgg_params is not None
 
     use_dropout = cfg.model.use_dropout
@@ -278,17 +281,26 @@ def build_train_step(
         real_b = ingest(batch["target"], train_dtype)
 
         # ---- 1. compression pre-filter + quantizer ----------------------
+        # delayed-int8 net_c threads its stored amax like batch_stats:
+        # the step-1 run's update is the one stored (the C-branch rerun
+        # below reads the same start-of-step scales and discards its
+        # proposal, mirroring the batch_stats_c convention)
         def compressed_fn(params_c):
-            raw, vc = c.apply(
-                {"params": params_c, "batch_stats": state.batch_stats_c},
-                real_b, True, mutable=["batch_stats"],
-            )
-            return quant(raw, bits), vc["batch_stats"]
+            variables = {"params": params_c,
+                         "batch_stats": state.batch_stats_c}
+            mut = ["batch_stats"]
+            if use_qc:
+                variables["quant"] = state.quant_c
+                mut.append("quant")
+            raw, vc = c.apply(variables, real_b, True, mutable=mut)
+            return (quant(raw, bits), vc["batch_stats"],
+                    vc.get("quant") if use_qc else state.quant_c)
 
         if use_c:
-            compressed, bs_c1 = compressed_fn(state.params_c)
+            compressed, bs_c1, quant_c1 = compressed_fn(state.params_c)
         else:
-            compressed, bs_c1 = real_a, state.batch_stats_c
+            compressed, bs_c1, quant_c1 = (real_a, state.batch_stats_c,
+                                           state.quant_c)
 
         g_input = jax.lax.stop_gradient(compressed)
 
@@ -484,7 +496,7 @@ def build_train_step(
         params_c1, opt_c1, bs_g2 = state.params_c, state.opt_c, bs_g1
         if use_c:
             def loss_c_fn(params_c):
-                cq, _ = compressed_fn(params_c)
+                cq, _, _ = compressed_fn(params_c)
                 c_rng = (jax.random.fold_in(drop_rng, 1)
                          if drop_rng is not None else None)
                 fake_ac, bs2, _ = g_fwd(params_g1, bs_g1, quant_g1, cq, c_rng)
@@ -514,6 +526,9 @@ def build_train_step(
                 ok_all = ok & jnp.isfinite(loss_c)
                 params_c1 = health_select(ok_all, params_c1, state.params_c)
                 opt_c1 = health_select(ok_all, opt_c1, state.opt_c)
+                if use_qc:
+                    quant_c1 = health_select(ok_all, quant_c1,
+                                             state.quant_c)
             bs_g2 = health_select(ok_all, bs_g2, state.batch_stats_g)
             bs_c1 = health_select(ok_all, bs_c1, state.batch_stats_c)
 
@@ -532,6 +547,7 @@ def build_train_step(
             pool_n=pool_n1,
             quant_g=quant_g1,
             quant_d=quant_d1,
+            quant_c=quant_c1,
             ema_g=ema_g1,
         )
         metrics = {
@@ -640,6 +656,8 @@ def build_pp_train_step(
     bits = cfg.model.quant_bits
     quant = quantize_ste if cfg.model.quant_ste else quantize
     use_c = cfg.model.use_compression_net
+    use_qc = (use_c and cfg.model.int8_delayed
+              and cfg.model.int8_compression)
     need_vgg = (L.lambda_vgg > 0) and vgg_params is not None
     use_quant_d = cfg.model.int8_delayed
     d_colls = ("spectral", "quant") if use_quant_d else ("spectral",)
@@ -673,18 +691,24 @@ def build_pp_train_step(
         flat = mb_major_flatten
 
         # ---- 1. compression pre-filter + quantizer (unpipelined: <1% of
-        # the FLOPs; its BatchNorm keeps train-mode stats) ---------------
+        # the FLOPs; its BatchNorm keeps train-mode stats; delayed-int8
+        # amax threads as quant_c exactly like the unpipelined step) ----
         def compressed_fn(params_c):
-            raw, vc = c.apply(
-                {"params": params_c, "batch_stats": state.batch_stats_c},
-                real_b, True, mutable=["batch_stats"],
-            )
-            return quant(raw, bits), vc["batch_stats"]
+            variables = {"params": params_c,
+                         "batch_stats": state.batch_stats_c}
+            mut = ["batch_stats"]
+            if use_qc:
+                variables["quant"] = state.quant_c
+                mut.append("quant")
+            raw, vc = c.apply(variables, real_b, True, mutable=mut)
+            return (quant(raw, bits), vc["batch_stats"],
+                    vc.get("quant") if use_qc else state.quant_c)
 
         if use_c:
-            compressed, bs_c1 = compressed_fn(state.params_c)
+            compressed, bs_c1, quant_c1 = compressed_fn(state.params_c)
         else:
-            compressed, bs_c1 = real_a, state.batch_stats_c
+            compressed, bs_c1, quant_c1 = (real_a, state.batch_stats_c,
+                                           state.quant_c)
         g_input = jax.lax.stop_gradient(compressed)
 
         stages_aux = {k: v for k, v in state.pp_stages.items()
@@ -796,7 +820,7 @@ def build_pp_train_step(
         params_c1, opt_c1 = state.params_c, state.opt_c
         if use_c:
             def loss_c_fn(params_c):
-                cq, _ = compressed_fn(params_c)
+                cq, _, _ = compressed_fn(params_c)
                 fake_ac, _ = g_pp(params_g1, stages_p1, cq, quant_s1)
                 loss = jnp.mean(
                     (fake_ac.astype(jnp.float32)
@@ -820,6 +844,8 @@ def build_pp_train_step(
             ok_all = ok & jnp.isfinite(loss_c)
             params_c1 = health_select(ok_all, params_c1, state.params_c)
             opt_c1 = health_select(ok_all, opt_c1, state.opt_c)
+            if use_qc:
+                quant_c1 = health_select(ok_all, quant_c1, state.quant_c)
         if ok is not None:
             bs_c1 = health_select(ok_all, bs_c1, state.batch_stats_c)
 
@@ -839,6 +865,7 @@ def build_pp_train_step(
             batch_stats_c=bs_c1,
             opt_c=opt_c1,
             quant_d=quant_d_out,
+            quant_c=quant_c1,
         )
         metrics = {
             "loss_d": loss_d.astype(jnp.float32),
@@ -934,11 +961,13 @@ def make_infer_forward(cfg: Config, train_dtype=None,
         real_a = ingest(batch["input"], train_dtype)
         if cfg.model.use_compression_net:
             real_b = ingest(batch["target"], train_dtype)
-            raw = c.apply(
-                {"params": state.params_c,
-                 "batch_stats": state.batch_stats_c},
-                real_b, False,
-            )
+            c_vars = {"params": state.params_c,
+                      "batch_stats": state.batch_stats_c}
+            if cfg.model.int8_delayed and cfg.model.int8_compression:
+                # frozen-scale serving for net_c: the stored amax is
+                # read-only here, exactly like quant_g below
+                c_vars["quant"] = state.quant_c
+            raw = c.apply(c_vars, real_b, False)
             g_in = quantize(raw, bits)
         else:
             g_in = real_a

@@ -208,6 +208,9 @@ class VideoTrainer:
             step = self.ckpt.last_restored_step
             aux = self.ckpt.restore_aux(int(step))
         finish_elastic_restore(self, int(step), plan)
+        # (no quant graft here: VideoTrainState carries no quant
+        # collections — the video trainer rejects int8_delayed outright,
+        # so the forward-compat amax machinery has nothing to arm)
         # exact-step resume (shared with Trainer.maybe_resume): a
         # mid-epoch (preemption) checkpoint re-enters its epoch at
         # clip-batch `mid`

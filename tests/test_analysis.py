@@ -504,18 +504,23 @@ def test_tp_leaf_spec_public_helper():
 # ------------------------------------------------------- the CLI gate
 
 
-# PR 8 started at 18 waivers; this PR re-audited them (three device_get
-# waivers became real fixes) and added the three new analyzers' documented
-# waivers. The ceiling only ever moves DOWN: converting a waiver into a
-# fix lowers it, adding one without touching this number fails CI.
-WAIVER_CEILING = 26
+# PR 8 started at 18 waivers; PR 9 re-audited them down to 26 (three
+# device_get waivers became real fixes). ISSUE 14 moves the ceiling to 31
+# — the ONE sanctioned kind of increase: draining the int8-coverage
+# worklist converts its 5 remaining sites into dated measured-rejected /
+# dispatch-table waivers (models/unet.py stem+head, models/patchgan.py
+# stem, ops/conv.py + ops/int8.py custom-VJP call sites), each stating
+# the verdict the waiver records. Absent another sanctioned drain, the
+# ceiling only ever moves DOWN: converting a waiver into a fix lowers
+# it, adding one without touching this number fails CI.
+WAIVER_CEILING = 31
 
 
 def test_lint_cli_strict_is_clean_on_this_repo(capsys):
     """THE standing gate: zero unwaived findings over the live repo
     (all EIGHT analyzers — the perf pair included), the waiver count
     reported exactly once under its pinned ceiling, the item-3 worklist
-    fully DRAINED, and a non-empty item-2 int8 worklist."""
+    fully DRAINED, and the item-2 int8 worklist DRAINED to 0 sites."""
     import re
 
     from p2p_tpu.cli.lint import main
@@ -531,10 +536,15 @@ def test_lint_cli_strict_is_clean_on_this_repo(capsys):
     # item-3 worklist is empty and no family may silently reappear
     assert "needs-predicate-rule" not in out
     assert "tp worklist 0 leaves" in out
-    # ...and the int8-coverage worklist is the standing NON-empty one
-    # (ROADMAP item 2) until the quantization lever drains it
+    # ISSUE 14: the int8-coverage worklist is DRAINED — 0 live sites
+    # over the full-coverage program (every remaining bf16 contraction
+    # carries a dated waiver), and no unwaived coverage-gap line may
+    # reappear (the CI grep's twin)
     assert "int8-coverage worklist" in out
-    assert re.search(r"int8 worklist [1-9]\d* sites", out), out
+    assert "int8 worklist 0 sites" in out
+    for line in out.splitlines():
+        if "perf-int8-coverage-gap" in line:
+            assert "waived:" in line, line
     m = re.search(r"— 0 unwaived findings, (\d+) waiver", out)
     assert m, out
     assert int(m.group(1)) <= WAIVER_CEILING, (
@@ -1442,6 +1452,12 @@ def test_sweep_roofline_row_mapping():
 
     assert roofline_row_for("facades_int8") == "train_step[facades_int8]"
     assert roofline_row_for("facades_int8") in PERF_BOUNDS
+    # ISSUE 14: the full-coverage overlay has its own canonical row with
+    # the post-drain int8 floor
+    assert roofline_row_for("facades_int8_full") == \
+        "train_step[facades_int8_full]"
+    full = PERF_BOUNDS[roofline_row_for("facades_int8_full")]
+    assert full["min_int8_mxu_fraction"] >= 0.80
     assert roofline_row_for("vid2vid_temporal") == \
         "video_train_step[vid2vid_temporal]"
     # the expand-family programs are not in the traced set yet
@@ -1587,22 +1603,48 @@ def test_int8_coverage_fixture_and_dedupe():
     assert f.rule == "perf-int8-coverage-gap" and f.severity == INFO
 
 
-def test_int8_coverage_on_real_preset_nonempty():
-    """--int8-diff's data source: the tiny facades_int8 train step has a
-    NON-empty worklist (stems/heads/C stay bf16 by design — ROADMAP
-    item 2's remaining lever), every entry locatable."""
+def test_int8_coverage_full_program_drained():
+    """ISSUE 14: the FULL-COVERAGE program's worklist drains to ZERO —
+    every raw site left contracting in bf16 carries a dated in-source
+    waiver (measured-rejected stems/image head, per-form dispatch-table
+    backward islands at the custom-VJP call sites), and the program
+    carries the post-drain int8 MXU share the roofline row pins."""
+    from p2p_tpu.analysis.findings import apply_pragma_waivers
+    from p2p_tpu.analysis.perf_audit import int8_coverage
+    from p2p_tpu.cli.lint import _int8_train_program
+
+    jx = _int8_train_program(full=True)
+    wl, findings = int8_coverage(jx, tag="train_step[facades_int8_full]")
+    # the raw enumeration is NON-empty (the deliberate bf16 islands are
+    # still in the tree — behind knobs/doctrine, not silently deleted)
+    assert wl and all(w["file"] and w["line"] for w in wl)
+    assert all(f.severity == INFO for f in findings)
+    findings = apply_pragma_waivers(findings)
+    unwaived = [f for f in findings if not f.waived]
+    assert unwaived == [], [
+        f"{f.file}:{f.line} {f.message}" for f in unwaived]
+    # every waiver carries a reason (the dated-verdict convention)
+    assert all(f.waive_reason for f in findings)
+    # post-drain int8 MXU share: the PERF_BOUNDS floor's live twin
+    from p2p_tpu.analysis.hlo_cost import program_cost
+
+    cost = program_cost(jx)
+    mxu = sum(cost["mxu_flops_by_dtype"].values())
+    assert cost["mxu_flops_by_dtype"].get("int8", 0) / mxu >= 0.80
+
+
+def test_int8_coverage_preset_program_still_partial():
+    """The SHIPPING facades_int8 preset (the headline bench row) keeps
+    its measured partial coverage — the full-coverage program is a
+    config overlay (core.config.int8_full_coverage), not a silent
+    rewrite of the preset."""
+    from p2p_tpu.analysis.hlo_cost import program_cost
     from p2p_tpu.analysis.perf_audit import int8_coverage
     from p2p_tpu.cli.lint import _int8_train_program
 
     jx = _int8_train_program()
-    wl, findings = int8_coverage(jx, tag="train_step[facades_int8]")
-    assert wl, "delayed-int8 worklist empty — either item 2 is done " \
-               "(update the CI gate!) or the trace lost its int8 convs"
-    assert all(w["file"] and w["line"] for w in wl)
-    assert all(f.severity == INFO for f in findings)
-    # ...and the program DOES carry int8 MXU work (the lever is on)
-    from p2p_tpu.analysis.hlo_cost import program_cost
-
+    wl, _ = int8_coverage(jx, tag="train_step[facades_int8]")
+    assert wl      # bf16 generator sites remain in the preset program
     assert program_cost(jx)["mxu_flops_by_dtype"].get("int8", 0) > 0
 
 

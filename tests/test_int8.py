@@ -548,3 +548,417 @@ def test_tiny_spatial_wgrad_guard_on_tpu():
         f"guarded (bf16-fallback) tiny-spatial backward failed on TPU:\n"
         f"{guarded.stderr[-2000:]}"
     )
+
+
+# ----------------------------------------------- kn2row int8 (ISSUE 14)
+
+
+KN2ROW_CASES = [
+    # (k, pad, cin, cout, H) — cout·k² ≪ cin, the thin-head regime
+    (4, 2, 32, 1, 9),       # the PatchGAN logits head's exact form
+    (3, 1, 32, 2, 8),
+    (2, 0, 16, 4, 6),
+]
+
+
+@pytest.mark.parametrize("k,pad,cin,cout,H", KN2ROW_CASES)
+def test_int8_kn2row_exact_vs_float_on_integer_grids(k, pad, cin, cout, H):
+    """ISSUE 14 (c): the s8×s8→s32 kn2row tap decomposition — forward
+    AND both cotangents exactly reproduce the float kn2row VJP on
+    integer-valued tensors (lossless quantization), per-form dispatch
+    included (int8 fwd/wgrad, bf16 dgrad)."""
+    from p2p_tpu.ops.conv import kn2row_thin_conv
+    from p2p_tpu.ops.int8 import int8_kn2row_conv
+
+    rng = np.random.default_rng(0)
+    x = _grid_ints(rng, (2, H, H, cin), scale=0.5)
+    w = _grid_ints(rng, (k, k, cin, cout), scale=0.25, channel_axis=3)
+
+    y8 = int8_kn2row_conv(x, w, pad)
+    yf = kn2row_thin_conv(x, w, pad)
+    np.testing.assert_allclose(np.asarray(y8), np.asarray(yf), rtol=1e-5)
+
+    ct = _grid_ints(rng, yf.shape, scale=2.0)
+    _, vjp8 = jax.vjp(lambda a, b: int8_kn2row_conv(a, b, pad), x, w)
+    _, vjpf = jax.vjp(lambda a, b: kn2row_thin_conv(a, b, pad), x, w)
+    dx8, dw8 = vjp8(ct)
+    dxf, dwf = vjpf(ct)
+    np.testing.assert_allclose(np.asarray(dx8), np.asarray(dxf), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw8), np.asarray(dwf), rtol=1e-4)
+
+
+def test_int8_kn2row_ds_matches_dynamic_when_scale_agrees():
+    """The delayed kn2row form: with the stored scale set to THIS batch's
+    amax/127 (what the dynamic path computes), outputs are bitwise equal
+    and the measured amax is the true max|x| (the update proposal)."""
+    from p2p_tpu.ops.int8 import int8_kn2row_conv, int8_kn2row_conv_ds
+
+    rng = np.random.default_rng(1)
+    x = _grid_ints(rng, (2, 9, 9, 32), scale=0.5)
+    w = _grid_ints(rng, (4, 4, 32, 1), scale=0.25, channel_axis=3)
+    sx = jnp.max(jnp.abs(x)) / 127.0
+    y_dyn = int8_kn2row_conv(x, w, 2)
+    y_ds, amax = int8_kn2row_conv_ds(x, w, sx, 2)
+    np.testing.assert_array_equal(np.asarray(y_ds), np.asarray(y_dyn))
+    assert float(amax) == float(jnp.max(jnp.abs(x)))
+
+
+def test_kn2row_conv_module_int8_param_compat_and_delayed_amax():
+    """KN2RowConv(int8=...): identical param tree to the bf16 kn2row
+    module (checkpoints interchange), close output; the delayed form
+    creates/updates an amax_x leaf in the 'quant' collection."""
+    from p2p_tpu.ops.conv import KN2RowConv
+
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 32))
+    ref = KN2RowConv(features=1, kernel_size=4, padding=2)
+    q = KN2RowConv(features=1, kernel_size=4, padding=2, int8=True)
+    v = ref.init(jax.random.key(1), x)
+    assert jax.tree_util.tree_structure(
+        q.init(jax.random.key(1), x)) == jax.tree_util.tree_structure(v)
+    yr = ref.apply(v, x)
+    yq = q.apply(v, x)
+    rel = (jnp.linalg.norm(yq - yr) / jnp.linalg.norm(yr)).item()
+    assert rel < 0.03, rel
+
+    dq = KN2RowConv(features=1, kernel_size=4, padding=2, int8=True,
+                    int8_delayed=True)
+    vd = dq.init(jax.random.key(1), x)
+    assert "quant" in vd and "amax_x" in vd["quant"]
+    before = float(vd["quant"]["amax_x"])
+    _, mut = dq.apply(vd, 2.0 * x, mutable=["quant"])
+    assert float(mut["quant"]["amax_x"]) > before
+
+
+def test_patchgan_int8_head_routes_kn2row_and_threads_quant():
+    """int8_head: the D logits head rides the quantized kn2row path —
+    its amax joins the 'quant' collection and moves — with the param
+    tree unchanged vs the bf16 head."""
+    from p2p_tpu.models.patchgan import NLayerDiscriminator
+
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 6))
+    kw = dict(ndf=8, n_layers=3, use_spectral_norm=False, int8=True,
+              int8_delayed=True)
+    ref = NLayerDiscriminator(**kw)
+    hq = NLayerDiscriminator(**kw, int8_head=True)
+    vr = ref.init(jax.random.key(1), x)
+    vh = hq.init(jax.random.key(1), x)
+    assert jax.tree_util.tree_structure(
+        vr["params"]) == jax.tree_util.tree_structure(vh["params"])
+    # the head conv (_PlainConv_4) gains an amax leaf under int8_head
+    assert "_PlainConv_4" in vh["quant"]
+    assert "_PlainConv_4" not in vr["quant"]
+    _, mut = hq.apply(vh, 3.0 * x, mutable=["quant"])
+    assert (float(mut["quant"]["_PlainConv_4"]["Conv_0"]["amax_x"])
+            > float(vh["quant"]["_PlainConv_4"]["Conv_0"]["amax_x"]))
+
+
+def test_unet_int8_stem_knob_param_compat():
+    """int8_stem quantizes down0 (param tree unchanged); default keeps
+    the measured-rejected bf16 stem (no amax leaf for down0)."""
+    from p2p_tpu.models.unet import UNetGenerator
+
+    x = jax.random.normal(jax.random.key(0), (1, 32, 32, 3))
+    kw = dict(ngf=8, num_downs=5, int8=True, int8_delayed=True)
+    ref = UNetGenerator(**kw)
+    st = UNetGenerator(**kw, int8_stem=True)
+    vr = ref.init(jax.random.key(1), x, train=False)
+    vs = st.init(jax.random.key(1), x, train=False)
+    assert jax.tree_util.tree_structure(
+        vr["params"]) == jax.tree_util.tree_structure(vs["params"])
+    assert "down0" in vs["quant"] and "down0" not in vr["quant"]
+
+
+# ----------------------------------- quantize-fused epilogue (ISSUE 14)
+
+
+def test_fused_epilogue_matches_unfused_bitwise():
+    """int8_fused_epilogue (norm_d instance family + delayed int8): the
+    [norm+LeakyReLU+quantize+amax]-fused D == the unfused module chain —
+    logits and amax updates BITWISE (the CPU reference path quantizes
+    the identical value), gradients within fp-reassociation noise (the
+    closed-form norm VJP sums in a different order; the only visible
+    divergence is on the norm-cancelled, mathematically-dead conv bias
+    gradients)."""
+    from p2p_tpu.models.patchgan import NLayerDiscriminator
+
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 32, 32, 6)).astype(np.float32))
+    kw = dict(ndf=8, n_layers=3, use_spectral_norm=False, int8=True,
+              int8_delayed=True, norm="instance", int8_head=True)
+    d_u = NLayerDiscriminator(**kw)
+    d_f = NLayerDiscriminator(**kw, int8_fused_epilogue=True)
+    vu = d_u.init(jax.random.key(0), x)
+    vf = d_f.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(vu) == \
+        jax.tree_util.tree_structure(vf)
+    for (pu, lu), (_, lf) in zip(
+            jax.tree_util.tree_leaves_with_path(vu),
+            jax.tree_util.tree_leaves_with_path(vf)):
+        np.testing.assert_array_equal(np.asarray(lu), np.asarray(lf),
+                                      err_msg=str(pu))
+    ou, mu = d_u.apply(vu, x, mutable=["quant"])
+    of, mf = d_f.apply(vf, x, mutable=["quant"])
+    np.testing.assert_array_equal(np.asarray(ou[-1]), np.asarray(of[-1]))
+    for (pu, lu), (_, lf) in zip(
+            jax.tree_util.tree_leaves_with_path(mu),
+            jax.tree_util.tree_leaves_with_path(mf)):
+        np.testing.assert_array_equal(np.asarray(lu), np.asarray(lf),
+                                      err_msg=str(pu))
+
+    def loss(mod, v):
+        def f(p):
+            out, _ = mod.apply({**v, "params": p}, x, mutable=["quant"])
+            return jnp.sum(out[-1].astype(jnp.float32) ** 2)
+        return f
+
+    gu = jax.grad(loss(d_u, vu))(vu["params"])
+    gf = jax.grad(loss(d_f, vf))(vf["params"])
+    for (pu, lu), (_, lf) in zip(
+            jax.tree_util.tree_leaves_with_path(gu),
+            jax.tree_util.tree_leaves_with_path(gf)):
+        np.testing.assert_allclose(np.asarray(lu), np.asarray(lf),
+                                   rtol=2e-4, atol=1e-4, err_msg=str(pu))
+
+    # ...and through the FEATURE-MATCHING taps: the fused taps are the
+    # dequantized surrogate by VALUE, but their cotangent must reach the
+    # epilogue unscaled (ops/int8.surrogate_tap) — a plain q·sx tap
+    # silently multiplied the FM gradients by sx (~amax/127 ≈ 0.03×),
+    # which only a feats-side loss can see
+    def fm_loss(mod, v):
+        def f(p):
+            out, _ = mod.apply({**v, "params": p}, x, mutable=["quant"])
+            return sum(jnp.sum(t.astype(jnp.float32) ** 2) for t in out)
+        return f
+
+    gu = jax.grad(fm_loss(d_u, vu))(vu["params"])
+    gf = jax.grad(fm_loss(d_f, vf))(vf["params"])
+    for (pu, lu), (_, lf) in zip(
+            jax.tree_util.tree_leaves_with_path(gu),
+            jax.tree_util.tree_leaves_with_path(gf)):
+        nu = float(jnp.linalg.norm(lu))
+        nf = float(jnp.linalg.norm(lf))
+        # skip the norm-cancelled dead-bias leaves: their gradients are
+        # identically-zero + fp noise (~1e-3), pure reassociation jitter
+        if nu > 1e-2:
+            assert 0.9 < nf / nu < 1.1, (str(pu), nf, nu)
+
+
+def test_fused_epilogue_requires_instance_norm():
+    from p2p_tpu.models.patchgan import NLayerDiscriminator
+
+    x = jnp.zeros((1, 16, 16, 6), jnp.float32)
+    d = NLayerDiscriminator(ndf=8, use_spectral_norm=False, int8=True,
+                            int8_delayed=True, int8_fused_epilogue=True,
+                            norm="none")
+    with pytest.raises(ValueError, match="instance-family"):
+        d.init(jax.random.key(0), x)
+
+
+def test_fused_epilogue_composes_with_spectral_norm():
+    """The spectral-norm D: fused epilogue == unfused, logits bitwise
+    (the power iteration runs on the true f32 weight either way)."""
+    from p2p_tpu.models.patchgan import NLayerDiscriminator
+
+    x = jnp.asarray(np.random.default_rng(2).normal(
+        size=(2, 32, 32, 6)).astype(np.float32))
+    kw = dict(ndf=8, n_layers=3, use_spectral_norm=True, int8=True,
+              int8_delayed=True, norm="instance")
+    d_u = NLayerDiscriminator(**kw)
+    d_f = NLayerDiscriminator(**kw, int8_fused_epilogue=True)
+    vu = d_u.init(jax.random.key(0), x)
+    vf = d_f.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(vu) == \
+        jax.tree_util.tree_structure(vf)
+    ou, _ = d_u.apply(vu, x, mutable=["quant", "spectral"])
+    of, _ = d_f.apply(vf, x, mutable=["quant", "spectral"])
+    np.testing.assert_array_equal(np.asarray(ou[-1]), np.asarray(of[-1]))
+
+
+# ----------------------------- net_c on the int8 path (ISSUE 14, d)
+
+
+def _compression_cfg(**model_kw):
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+
+    cfg = get_preset("facades_int8")
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, ngf=8, ndf=8,
+                                  use_compression_net=True,
+                                  int8_compression=True, **model_kw),
+        data=dataclasses.replace(cfg.data, image_size=16, batch_size=2),
+    )
+
+
+def _u8_batch(seed=0, n=2, size=16):
+    rng = np.random.default_rng(seed)
+    return {"input": rng.integers(0, 255, (n, size, size, 3)).astype(
+                np.uint8),
+            "target": rng.integers(0, 255, (n, size, size, 3)).astype(
+                np.uint8)}
+
+
+def test_compression_net_int8_trains_and_frozen_scale_eval_bitwise():
+    """net_c on the delayed-int8 path: quant_c exists, threads through
+    the train step (amax moves, update stored from the step-1 run), and
+    frozen-scale eval is bitwise identical between the trainer's eval
+    step and the serving InferState slice."""
+    from p2p_tpu.train.state import create_train_state, infer_state_from_train
+    from p2p_tpu.train.step import build_eval_step, build_train_step
+
+    cfg = _compression_cfg()
+    batch = _u8_batch()
+    state = create_train_state(cfg, jax.random.key(0), batch,
+                               train_dtype=jnp.bfloat16)
+    assert len(jax.tree_util.tree_leaves(state.quant_c)) == 3  # 3 convs
+    before = [float(a) for a in jax.tree_util.tree_leaves(state.quant_c)]
+    step = build_train_step(cfg, train_dtype=jnp.bfloat16, jit=False)
+    state, m = step(state, _u8_batch(seed=1))
+    assert np.isfinite(float(m["loss_c"]))
+    after = [float(a) for a in jax.tree_util.tree_leaves(state.quant_c)]
+    assert after != before, "quant_c never moved through the step"
+
+    ev = build_eval_step(cfg, jnp.bfloat16, jit=False)
+    eval_batch = _u8_batch(seed=2)
+    p1, _ = ev(state, eval_batch)
+    p2, _ = ev(infer_state_from_train(state), eval_batch)
+    np.testing.assert_array_equal(np.asarray(p1, np.float32),
+                                  np.asarray(p2, np.float32))
+
+
+# ------------------------- forward-compat restore (ISSUE 14, sat. 3)
+
+
+def test_pre_drain_checkpoint_restores_with_initialized_amax(tmp_path):
+    """A checkpoint saved BEFORE the coverage drain (missing the new
+    amax leaves: wider G coverage, the kn2row head, all of quant_c)
+    restores under the widened config with those leaves initialized from
+    the template — params bitwise from disk, shared amax bitwise from
+    disk, NO Orbax structure error — and reports the grafted paths so
+    the trainer can arm the --recalibrate_steps warmup. A same-config
+    restore stays byte-identical behavior with no graft flags."""
+    import dataclasses
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.train.checkpoint import CheckpointManager
+    from p2p_tpu.train.state import create_train_state
+
+    base = get_preset("facades_int8")
+
+    def tiny(**mk):
+        return dataclasses.replace(
+            base,
+            model=dataclasses.replace(base.model, ngf=8, ndf=8,
+                                      use_compression_net=True, **mk),
+            data=dataclasses.replace(base.data, image_size=16,
+                                     batch_size=2),
+        )
+
+    batch = _u8_batch()
+    st_old = create_train_state(tiny(), jax.random.key(0), batch,
+                                train_dtype=jnp.bfloat16)
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d)
+    mgr.save(7, st_old, wait=True)
+
+    cfg_new = tiny(int8_generator=True, int8_head=True,
+                   int8_compression=True)
+    st_new = create_train_state(cfg_new, jax.random.key(1), batch,
+                                train_dtype=jnp.bfloat16)
+    m2 = CheckpointManager(d)
+    restored = m2.restore(st_new)
+    grafted = m2.last_restore_initialized_quant
+    assert len(grafted) == 7, grafted      # 3 encoder + head + 3 net_c
+    assert any(p.startswith("quant_c/") for p in grafted)
+    # params bitwise from disk (the graft touched ONLY quant leaves)
+    for (pa, la), (_, lb) in zip(
+            jax.tree_util.tree_leaves_with_path(st_old.params_g),
+            jax.tree_util.tree_leaves_with_path(restored.params_g)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=str(pa))
+    # shared quant leaves from disk, new trees match the template
+    np.testing.assert_array_equal(
+        np.asarray(restored.quant_d["scale0"]["_PlainConv_1"]["Conv_0"]
+                   ["amax_x"]),
+        np.asarray(st_old.quant_d["scale0"]["_PlainConv_1"]["Conv_0"]
+                   ["amax_x"]))
+    assert jax.tree_util.tree_structure(restored.quant_g) == \
+        jax.tree_util.tree_structure(st_new.quant_g)
+    assert jax.tree_util.tree_structure(restored.quant_c) == \
+        jax.tree_util.tree_structure(st_new.quant_c)
+    # same-config restore: untouched path, no graft flags
+    m3 = CheckpointManager(d)
+    m3.restore(st_old)
+    assert m3.last_restore_initialized_quant == []
+
+
+def test_quant_init_graft_arms_recalibrate_warmup(tmp_path):
+    """arm_quant_init_warmup: a restore that grafted amax leaves logs a
+    quant_init record and (with --recalibrate_steps) opens the SAME
+    frozen-scale window hold_frozen_quant re-pins — reusing the
+    tp_amax_recalibrate machinery."""
+    import dataclasses
+    from types import SimpleNamespace
+
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.resilience.reshape import (
+        arm_quant_init_warmup,
+        hold_frozen_quant,
+    )
+
+    cfg = dataclasses.replace(
+        get_preset("facades_int8"),
+        train=dataclasses.replace(get_preset("facades_int8").train,
+                                  recalibrate_steps=2))
+    logs = []
+
+    class _State(SimpleNamespace):
+        def replace(self, **kw):
+            d = dict(self.__dict__)
+            d.update(kw)
+            return _State(**d)
+
+    state = _State(
+        quant_g={"down1": {"amax_x": jnp.float32(3.0)}},
+        quant_d=None, quant_c=None, pp_stages=None)
+    tr = SimpleNamespace(
+        cfg=cfg, state=state, _host_step=0,
+        ckpt=SimpleNamespace(
+            last_restore_initialized_quant=["quant_g/down1/amax_x"]),
+        logger=SimpleNamespace(log=lambda rec, force=False:
+                               logs.append(rec)))
+    arm_quant_init_warmup(tr, 7)
+    assert logs and logs[0]["kind"] == "quant_init"
+    assert logs[0]["initialized_leaves"] == 1
+    assert tr._quant_freeze_remaining == 2
+    assert "quant_g" in tr._quant_frozen
+    # the warmup window: each dispatch re-pins the frozen scales
+    tr.state.quant_g["down1"]["amax_x"] = jnp.float32(99.0)
+    hold_frozen_quant(tr)
+    assert float(tr.state.quant_g["down1"]["amax_x"]) == 3.0
+    assert tr._quant_freeze_remaining == 1
+    # no graft -> no-op
+    tr2 = SimpleNamespace(
+        cfg=cfg, state=state,
+        ckpt=SimpleNamespace(last_restore_initialized_quant=[]),
+        logger=SimpleNamespace(log=lambda rec, force=False:
+                               logs.append(rec)))
+    n_logs = len(logs)
+    arm_quant_init_warmup(tr2, 8)
+    assert len(logs) == n_logs
+
+
+def test_int8_full_coverage_overlay():
+    """core.config.int8_full_coverage: the ONE shared override set (lint
+    traced program == BENCH_INT8_FULL row) — coverage knobs on, stems
+    deliberately left to their measured-rejected default."""
+    from p2p_tpu.core.config import get_preset, int8_full_coverage
+
+    cfg = int8_full_coverage(get_preset("facades_int8"))
+    m = cfg.model
+    assert m.int8 and m.int8_delayed and m.int8_generator
+    assert m.int8_decoder and m.int8_head and m.int8_compression
+    assert m.use_compression_net
+    assert not m.int8_stem            # measured-rejected, knob stays off

@@ -85,6 +85,102 @@ def test_norm_act_fused_fwd_bwd_vs_lax(act, residual):
         assert _max_rel(a, r_) < 1e-4
 
 
+# --------------------------------------------------- norm_act_quant (14)
+@pytest.mark.parametrize("act", ["none", "relu", "leaky"])
+@pytest.mark.parametrize("affine", [False, True])
+def test_norm_act_quant_fused_fwd_vs_reference(act, affine):
+    """The quantize-fused epilogue kernel (interpret mode) == the lax
+    reference: int8-grid output (integer values in [-127,127], carried in
+    the compute dtype), identical amax proposal. The two backends compute
+    the norm statistics with different (equivalent) formulas, so a value
+    EXACTLY on a rounding boundary may flip by one grid step — bounded,
+    rare, and asserted as such."""
+    from p2p_tpu.ops.pallas.norm_act import instance_norm_act_quant
+
+    x = _rand((2, 8, 6, 5), 7)
+    s = _rand((5,), 8) if affine else None
+    b = _rand((5,), 9) if affine else None
+    sx = jnp.float32(0.01234)
+    yq_k, amax_k = instance_norm_act_quant(
+        x, sx, s, b, act=act, use_kernel=True, interpret=True)
+    yq_r, amax_r = instance_norm_act_quant(
+        x, sx, s, b, act=act, use_kernel=False)
+    assert yq_k.dtype == x.dtype and yq_r.dtype == x.dtype
+    got = np.asarray(yq_k, np.float32)
+    ref = np.asarray(yq_r, np.float32)
+    assert np.all(np.abs(got) <= 127) and np.all(got == np.round(got))
+    assert np.max(np.abs(got - ref)) <= 1
+    assert (got == ref).mean() > 0.99
+    assert abs(float(amax_k) - float(amax_r)) <= 1e-5 * max(
+        1.0, abs(float(amax_r)))
+
+
+@pytest.mark.parametrize("act", ["relu", "leaky"])
+def test_norm_act_quant_bwd_is_the_ste_law(act):
+    """Backward of the quantize-fused epilogue mirrors the delayed-int8
+    STE law. The op's contract (module docstring): the incoming
+    cotangent is w.r.t. the DEQUANTIZED surrogate sx·q — exactly what
+    ``int8_conv_pq`` hands back — and passes straight through clip/round
+    onto the act/norm VJP. So feeding the surrogate cotangent of
+    ``L = Σ sin(ŷ)`` must reproduce the gradient of the UNQUANTIZED
+    reference chain up to quantization noise in the cotangent itself;
+    the stored scale gets a ZERO cotangent exactly (state, not a
+    parameter)."""
+    from p2p_tpu.ops.pallas.norm_act import instance_norm_act_quant
+
+    x = _rand((2, 8, 6, 5), 10)
+    s, b = _rand((5,), 11), _rand((5,), 12)
+    # a CALIBRATED stored scale (amax/127, what the delayed path
+    # converges to) — an undersized scale would clip, and clipping is
+    # deliberately outside the STE identity this pin states
+    y0 = _xla_instance_norm_act(x, s, b, None, act, 0.2, 1e-5)
+    sx = jnp.float32(jnp.max(jnp.abs(y0)) / 127.0)
+
+    def fused(xx, ss, bb):
+        return instance_norm_act_quant(
+            xx, sx, ss, bb, act=act, use_kernel=True, interpret=True)
+
+    (q, _), vjp_f = jax.vjp(fused, x, s, b)
+    ct = jnp.cos(q.astype(jnp.float32) * sx)        # dL/dŷ, L = Σ sin(ŷ)
+    g_f = vjp_f((ct.astype(q.dtype), jnp.zeros((), jnp.float32)))
+
+    def ref(xx, ss, bb):
+        return _xla_instance_norm_act(xx, ss, bb, None, act, 0.2, 1e-5)
+
+    y_ref, vjp_r = jax.vjp(ref, x, s, b)
+    g_r = vjp_r(jnp.cos(y_ref.astype(jnp.float32)).astype(y_ref.dtype))
+    for a, r in zip(g_f, g_r):
+        # the two cotangents differ only by the quantization error of ŷ
+        # (≤ sx/2 per element; cos amplifies it near zero crossings —
+        # hence the absolute term)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=5e-2, atol=0.12)
+    # dsx is identically zero by the delayed-scale contract
+    dsx = jax.grad(lambda sxx: jnp.sum(instance_norm_act_quant(
+        x, sxx, s, b, act=act, use_kernel=True, interpret=True
+    )[0].astype(jnp.float32)))(sx)
+    assert float(dsx) == 0.0
+
+
+def test_make_norm_act_quant_seam_routes_and_guards():
+    """ops/norm.make_norm_act quant_scale form: the pallas_instance kind
+    emits (q, amax); stateful kinds refuse; residual composition
+    refuses (no quantized resblock tail in the zoo)."""
+    from p2p_tpu.ops.norm import make_norm_act
+
+    x = _rand((2, 8, 6, 5), 13)
+    na = make_norm_act("pallas_instance")
+    q, amax = na(x, act="leaky", slope=0.2, quant_scale=jnp.float32(0.01))
+    qv = np.asarray(q, np.float32)
+    assert np.all(np.abs(qv) <= 127) and np.all(qv == np.round(qv))
+    assert float(amax) > 0
+    with pytest.raises(ValueError):
+        na(x, act="leaky", residual=x, quant_scale=jnp.float32(0.01))
+    with pytest.raises(ValueError):
+        make_norm_act("batch")(x, act="leaky",
+                               quant_scale=jnp.float32(0.01))
+
+
 def test_norm_act_rejects_bad_act_and_slope():
     from p2p_tpu.ops.pallas.norm_act import instance_norm_act_fused
 

@@ -252,6 +252,26 @@ def test_int8_frozen_scale_engine_matches_eval_step(batch):
                                   np.asarray(pred_eval, np.float32))
 
 
+def test_int8_compression_net_frozen_scale_engine_matches_eval(batch):
+    """ISSUE 14: net_c on the delayed-int8 path — quant_c rides
+    InferState and is read FROZEN at serve time; engine output equals
+    the trainer's own eval step bitwise (the quant_g pin's net_c twin,
+    and the SERVING.md frozen-scale contract for the compression net)."""
+    cfg = unet_config(int8=True, int8_delayed=True,
+                      use_compression_net=True, int8_compression=True)
+    state = create_train_state(cfg, jax.random.key(0), batch, 1)
+    state, _ = build_train_step(cfg, None, 1, None)(state, dict(batch))
+    assert jax.tree_util.tree_leaves(state.quant_c)   # net_c scales live
+    istate = infer_state_from_train(state)
+    assert jax.tree_util.tree_leaves(istate.quant_c)  # ...and serve-side
+    imgs = synthetic_batch(2, 32, seed=6, dtype="uint8")
+    pred_engine, _, _ = InferenceEngine(
+        cfg, istate, dtype="f32").infer_batch(imgs)
+    pred_eval, _ = build_eval_step(cfg, None)(state, imgs)
+    np.testing.assert_array_equal(np.asarray(pred_engine, np.float32),
+                                  np.asarray(pred_eval, np.float32))
+
+
 # --------------------------------------------------------------- TP serving
 def test_tp_sharded_engine_matches_single_device(devices8, batch):
     from p2p_tpu.core.mesh import make_mesh
