@@ -197,7 +197,8 @@ def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
     # 23.75 / 0.794 / 0.398 — at the dynamic-path peak level).
     preset = os.environ.get("BENCH_PRESET", "facades_int8")
     cfg = get_preset(preset)
-    facades_like = preset in ("facades", "facades_int8")
+    facades_like = preset in ("facades", "facades_int8",
+                              "facades_int8_full")
     # BENCH_IMG overrides to a square size; otherwise non-default presets
     # bench at their NATIVE dims (e.g. pix2pixhd 1024×512), facades at 256².
     if tiny:
@@ -240,16 +241,6 @@ def run_single(tiny: bool = False, with_sentinel: bool = False) -> dict:
         cfg = cfg.replace(model=dataclasses.replace(
             cfg.model, int8=True, int8_generator=both))
         preset = preset + ("_i8gd" if both else "_i8d")
-    if os.environ.get("BENCH_INT8_FULL", "") == "1":
-        # full-model delayed int8 (ISSUE 14): the ONE shared override
-        # set (core.config.int8_full_coverage — generator encoder+
-        # decoder, D inner+kn2row head, net_c; stems/image head stay
-        # bf16 per their dated waivers), identical to the program the
-        # lint's train_step[facades_int8_full] roofline row audits
-        from p2p_tpu.core.config import int8_full_coverage
-
-        cfg = int8_full_coverage(cfg)
-        preset = preset + "_i8full"
     if (os.environ.get("BENCH_DELAYED", "") == "1"
             and not cfg.model.int8_delayed):
         # delayed (stored-scale) activation quantization, ops/int8.py
@@ -500,7 +491,8 @@ def run_infer(tiny: bool = False) -> dict:
     on_tpu = platform == "tpu"
     preset = os.environ.get("BENCH_PRESET", "facades_int8")
     cfg = get_preset(preset)
-    facades_like = preset in ("facades", "facades_int8")
+    facades_like = preset in ("facades", "facades_int8",
+                              "facades_int8_full")
     if tiny:
         img, wid = 32, (64 if cfg.data.image_width else None)
         bs, n_batches = 2, 2
@@ -766,13 +758,14 @@ SWEEP_ROWS = [
              "BENCH_NORM": "pallas_instance"},
      "band": None},
     # round-8 row (ISSUE 14): FULL-model delayed int8 on the headline
-    # facades config — the drained-worklist coverage set
-    # (core.config.int8_full_coverage: generator encoder+decoder, D
-    # inner convs + kn2row head, net_c; stems/image head bf16 per their
-    # dated waivers). Band-pending until measured on-chip; the lint's
-    # train_step[facades_int8_full] roofline row is its static twin.
-    {"name": "facades_int8_full", "env": {"BENCH_INT8_FULL": "1"},
-     "band": None},
+    # facades config — the drained-worklist coverage set, now a FIRST-
+    # CLASS preset (ISSUE 15: the former BENCH_INT8_FULL opt-out env
+    # gate is gone, the measurement of record for the ROADMAP item-2
+    # band decision rides every default sweep). Band-pending until
+    # measured on-chip; the lint's train_step[facades_int8_full]
+    # roofline row is its static twin.
+    {"name": "facades_int8_full",
+     "env": {"BENCH_PRESET": "facades_int8_full"}, "band": None},
     # round-7 row (ISSUE 12): the open-loop serving-latency row — the
     # continuous-batching stack behind the HTTP frontend (run_serve);
     # value is served img/sec, the record carries p50/p99 request latency
@@ -797,8 +790,7 @@ def run_sweep(dry_run: bool = False) -> int:
     # the sweep owns these knobs; a stray env override would silently
     # bench a different contract than the bands record
     owned = ("BENCH_PRESET", "BENCH_BS", "BENCH_INT8", "BENCH_DELAYED",
-             "BENCH_IMG", "BENCH_NORM", "BENCH_NORMD", "BENCH_BREAKDOWN",
-             "BENCH_INT8_FULL")
+             "BENCH_IMG", "BENCH_NORM", "BENCH_NORMD", "BENCH_BREAKDOWN")
     saved = {k: os.environ.pop(k) for k in owned if k in os.environ}
     if saved:
         print(f"note: ignoring {sorted(saved)} for --sweep",
@@ -816,9 +808,6 @@ def run_sweep(dry_run: bool = False) -> int:
             return None          # the traced set models train/eval steps
         env = row["env"]
         preset = env.get("BENCH_PRESET", "facades_int8")
-        if env.get("BENCH_INT8_FULL"):
-            # the full-coverage overlay has its own canonical row
-            return roofline_row_for("facades_int8_full")
         if env.get("BENCH_INT8"):
             return (roofline_row_for("facades_int8")
                     if preset in ("facades", "edges2shoes_dp") else None)

@@ -326,7 +326,7 @@ PERF_BOUNDS: Dict[str, Dict[str, float]] = {
     },
     # ai 1.6768, int8 MXU share 0.9012 — the FULL-COVERAGE program
     # (core.config.int8_full_coverage; the --int8-diff audit subject and
-    # the BENCH_INT8_FULL band-pending row). The 0.80 floor is the
+    # the facades_int8_full band-pending sweep row). The 0.80 floor is the
     # post-drain contract: a coverage regression (a de-quantized conv
     # family, a new unknobbed layer) fails CI as out-of-bounds here even
     # before its worklist line is noticed.
@@ -359,7 +359,7 @@ PERF_BOUNDS: Dict[str, Dict[str, float]] = {
 _SWEEP_ROOFLINE = {
     "facades": "train_step[facades]",
     "facades_int8": "train_step[facades_int8]",
-    # the BENCH_INT8_FULL sweep row's key (a config overlay on the
+    # the facades_int8_full sweep row's key (a first-class preset on the
     # facades_int8 preset — core.config.int8_full_coverage)
     "facades_int8_full": "train_step[facades_int8_full]",
     "edges2shoes_dp": "train_step[facades]",     # same U-Net family

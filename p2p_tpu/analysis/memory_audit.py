@@ -6,10 +6,16 @@ device compute, so a 1024×512 preset budgets on a 1-CPU CI runner:
 1. **State budget** (:func:`state_budget`): per-device bytes of the full
    TrainState — params / optimizer moments / EMA / quant scales / other —
    for a named config × mesh (plain ``{axis: size}`` dicts, no devices).
-   Layout comes from the live sharding rules: the TP pair assignment
-   (``parallel/tp.tp_leaf_spec``) when the mesh has a real model axis,
-   replicated otherwise — i.e. the budget reflects what the trainers
-   actually place.
+   Layout comes from THE live partitioner
+   (``parallel/rules.trainstate_rules``): Megatron TP pair shards when
+   the mesh has a real model axis, ZeRO optimizer/EMA (± param) shards
+   when it has a real fsdp axis, replicated otherwise — i.e. the budget
+   reflects exactly what the trainers place. Every fsdp row additionally
+   carries ``opt_ema_reduction`` vs its fsdp=1 twin, and
+   ``memory-fsdp-shortfall`` (error) fires when the sharded
+   optimizer+EMA bytes fail the ZeRO arithmetic — at least
+   (axis−1)/axis of the replicated bytes must vanish (small slack for
+   the indivisible leaves: Adam count scalars, odd-width heads).
 2. **Activation peak** (:func:`traced_peak_bytes`): a linear liveness scan
    over the traced train-step jaxpr — allocate each eqn's outputs, free
    every value after its last use, track the high-water mark. An UPPER
@@ -48,6 +54,13 @@ RULE_DONATION_MISSING = "memory-donation-missing"
 RULE_DONATION_DEFEATED = "memory-donation-defeated"
 RULE_DEAD_RESTORE = "memory-dead-restore"
 RULE_OVER_HBM = "memory-over-hbm"
+RULE_FSDP_SHORTFALL = "memory-fsdp-shortfall"
+
+#: tolerated shortfall from the ideal 1/axis optimizer+EMA bytes: the
+#: leaves the fsdp spec builder legally replicates (Adam count scalars,
+#: inject_hyperparams scalars, dims no axis divides) are a fixed few
+#: hundred bytes — 2% covers them on every checked-in config
+FSDP_REDUCTION_SLACK = 0.02
 
 #: default per-device HBM budget (v5e-class chip), overridable via
 #: ``P2P_HBM_GB`` for other parts
@@ -57,12 +70,18 @@ DEFAULT_HBM_GB = 16.0
 #: each preset is its canonical topology (over-budget there is a warning;
 #: hypothetical rows report at info level via the table only).
 MEMORY_MATRIX: Tuple[Tuple[str, Tuple[Dict[str, int], ...]], ...] = (
-    ("facades", ({"data": 1}, {"data": 1, "model": 2})),
-    ("facades_int8", ({"data": 1},)),
-    ("edges2shoes_dp", ({"data": 8}, {"data": 4, "model": 2})),
+    ("facades", ({"data": 1}, {"data": 1, "model": 2},
+                 # ISSUE 15 canonical fsdp rows: the ZeRO optimizer+EMA
+                 # shard — CI asserts each row's opt_ema_reduction ≥
+                 # (axis−1)/axis − slack vs its fsdp=1 twin
+                 {"data": 1, "fsdp": 4})),
+    ("facades_int8", ({"data": 1}, {"data": 1, "fsdp": 2})),
+    ("edges2shoes_dp", ({"data": 8}, {"data": 4, "model": 2},
+                        {"data": 2, "fsdp": 4})),
     ("cityscapes_spatial", ({"data": 2, "spatial": 2},)),
     ("pix2pixhd", ({"data": 1, "spatial": 2},
-                   {"data": 1, "spatial": 2, "model": 2})),
+                   {"data": 1, "spatial": 2, "model": 2},
+                   {"data": 1, "spatial": 2, "fsdp": 2})),
 )
 
 
@@ -87,40 +106,48 @@ def _component(name: str) -> str:
 
 
 def state_budget(cfg, mesh_sizes: Dict[str, int],
-                 tp_min_ch: int = 512) -> Dict[str, int]:
+                 tp_min_ch: int = 512,
+                 fsdp_params: bool = False) -> Dict[str, int]:
     """Per-device TrainState bytes by component for ``cfg`` on a
-    hypothetical mesh. The layout law mirrors the trainers: TP channel
-    shards via ``tp_leaf_spec`` when ``model > 1``, everything else
-    replicated (so data/spatial/time axes do NOT divide state bytes —
-    exactly the FSDP gap ROADMAP item 3 names)."""
+    hypothetical mesh. The layout law IS the live partitioner
+    (``parallel/rules.trainstate_rules`` resolved per leaf): TP channel
+    shards when ``model > 1``, ZeRO optimizer/EMA (± param under
+    ``fsdp_params``) shards when ``fsdp > 1``, everything else
+    replicated — data/spatial/time axes still do NOT divide state
+    bytes."""
     import jax
 
-    from p2p_tpu.analysis.sharding_audit import (
-        _is_scalar,
-        abstract_train_state,
+    from p2p_tpu.analysis.sharding_audit import abstract_train_state
+    from p2p_tpu.parallel.rules import (
+        leaf_path_name,
+        match_partition_rules,
+        trainstate_rules,
     )
-    from p2p_tpu.parallel.tp import tp_leaf_spec
 
-    model = int(mesh_sizes.get("model", 1))
+    sizes = {str(k): int(v) for k, v in mesh_sizes.items()}
+    rules = trainstate_rules(sizes, tp_min_ch=tp_min_ch,
+                             fsdp_params=fsdp_params)
     out: Dict[str, int] = {"params": 0, "opt": 0, "ema": 0, "quant": 0,
                            "other": 0}
-    state = abstract_train_state(cfg)
-    flat, _ = jax.tree_util.tree_flatten_with_path(state)
-    from p2p_tpu.parallel.rules import leaf_path_name
+    from jax.sharding import PartitionSpec as P
 
-    for path, leaf in flat:
+    state = abstract_train_state(cfg)
+    specs = match_partition_rules(rules, state)
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    # P may subclass tuple on this jax — is_leaf keeps each spec atomic
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    for (path, leaf), spec in zip(flat, flat_specs):
         name = leaf_path_name(path)
-        shape = tuple(getattr(leaf, "shape", ()))
         nbytes = leaf_nbytes(leaf)
-        if model > 1 and not _is_scalar(shape):
-            spec = tp_leaf_spec(jax.tree_util.keystr(path), shape,
-                                model, tp_min_ch)
-            shard = 1
-            for entry in tuple(spec):
-                if entry is not None:
-                    shard *= model
-            nbytes //= max(1, shard)
-        out[_component(name)] += nbytes
+        shard = 1
+        for entry in tuple(spec or ()):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for a in axes:
+                shard *= sizes.get(str(a), 1)
+        out[_component(name)] += nbytes // max(1, shard)
     out["state_total"] = sum(out.values())
     return out
 
@@ -240,7 +267,8 @@ def memory_budget_table(hbm_gb: Optional[float] = None,
         # per-device batch and inversely in the activation-sharding axes
         act1 = activation_peak_bytes(cfg, 1)
         for j, mesh in enumerate(meshes):
-            data = int(mesh.get("data", 1))
+            # batches shard over data AND fsdp (core/mesh.BATCH_AXES)
+            data = int(mesh.get("data", 1)) * int(mesh.get("fsdp", 1))
             act_shard = int(mesh.get("spatial", 1)) * int(mesh.get("time", 1))
             local_bs = max(1, cfg.data.batch_size // max(1, data))
             state = state_budget(cfg, mesh,
@@ -258,6 +286,31 @@ def memory_budget_table(hbm_gb: Optional[float] = None,
                 "hbm_budget_bytes": budget,
                 "fits": total <= budget,
             }
+            fsdp = int(mesh.get("fsdp", 1))
+            if fsdp > 1:
+                # the ZeRO arithmetic, CI-asserted: vs the same config on
+                # the fsdp=1 twin mesh, per-device optimizer+EMA bytes
+                # must drop by at least (axis-1)/axis (minus the slack
+                # the indivisible leaves cost)
+                twin = state_budget(cfg, {**mesh, "fsdp": 1},
+                                    tp_min_ch=cfg.parallel.tp_min_ch)
+                rep = twin["opt"] + twin["ema"]
+                shd = state["opt"] + state["ema"]
+                reduction = 1.0 - (shd / rep) if rep else 0.0
+                row["opt_ema_reduction"] = round(reduction, 4)
+                row["fsdp_axis"] = fsdp
+                floor = (fsdp - 1) / fsdp - FSDP_REDUCTION_SLACK
+                if reduction < floor:
+                    findings.append(Finding(
+                        rule=RULE_FSDP_SHORTFALL, severity=ERROR,
+                        path=f"{preset}×{mesh}",
+                        message=f"fsdp={fsdp} sharded optimizer+EMA bytes "
+                                f"{shd} vs replicated {rep}: reduction "
+                                f"{reduction:.3f} < required "
+                                f"{floor:.3f} — the ZeRO rules stopped "
+                                "sharding this state (dead rule? pattern "
+                                "drift?)",
+                    ))
             rows.append(row)
             if j == 0 and not row["fits"]:
                 findings.append(Finding(

@@ -20,12 +20,13 @@ State trees come from ``jax.eval_shape`` over the real constructors
 (:func:`abstract_train_state`) — shapes and paths only, no allocation, so
 the full-size preset states audit on a CPU CI runner.
 
-The ``tp``-diff mode (:func:`tp_rule_gaps`) diffs the hand-built
+The ``tp``-diff mode (:func:`tp_rule_gaps`) diffs the reference
 shape-conditional TP assignment (:func:`p2p_tpu.parallel.tp.tp_leaf_spec`)
 against a declarative rule table and reports exactly which leaves the
-table cannot yet express — the ROADMAP item-3 migration worklist: each
-entry is a leaf that still needs a predicate rule before
-``tp_sharding_tree`` can retire.
+table cannot express. The worklist is DRAINED and the hand-built tree is
+retired to a shim (ISSUE 15): the live layouts run from
+``parallel/rules.py`` alone, and this diff is the standing proof the
+tables still reproduce the reference assignment.
 """
 
 from __future__ import annotations
@@ -107,9 +108,14 @@ def _is_scalar(shape: Tuple[int, ...]) -> bool:
 def _table_axis_findings(compiled, sizes: Dict[str, int]) -> List[Finding]:
     """Unknown-axis check runs TABLE-level, once per rule, so a dead or
     shadowed rule's bogus axis is still reported (per-leaf checking would
-    mask it — the rule never fires on anything)."""
+    mask it — the rule never fires on anything). Spec-BUILDER rules
+    (callable specs, the fsdp table) have no table-level spec to inspect
+    — ``audit_rules`` collects the axes their per-leaf resolutions
+    actually name and reports through the same rule id."""
     out: List[Finding] = []
     for idx, (_, pat, spec, _pred) in enumerate(compiled):
+        if callable(spec):
+            continue
         missing = sorted({a for _, axes in _spec_partitions(spec)
                           for a in axes if a not in sizes})
         if missing:
@@ -156,7 +162,7 @@ def audit_rules(rules: Sequence[Tuple[str, Any]], tree: Any,
     """Statically verify a rule table against a state tree (and optionally
     a mesh topology). Returns findings; an empty list is the audit's
     "every leaf matches, every rule earns its place" certificate."""
-    from p2p_tpu.parallel.rules import rule_parts
+    from p2p_tpu.parallel.rules import resolve_spec, rule_parts
 
     sizes = mesh_axis_sizes(mesh)
     leaves = named_leaves(tree)
@@ -169,6 +175,10 @@ def audit_rules(rules: Sequence[Tuple[str, Any]], tree: Any,
         findings.extend(_table_axis_findings(compiled, sizes))
     fired = [0] * len(compiled)
     claimed_by: Dict[str, int] = {}
+    #: rule idx -> axes its spec-BUILDER resolutions named (callable
+    #: specs have no table-level view — the unknown-axis check runs on
+    #: this union after the leaf walk)
+    builder_axes: Dict[int, set] = {}
 
     for name, _, shape in leaves:
         if _is_scalar(shape):
@@ -178,8 +188,13 @@ def audit_rules(rules: Sequence[Tuple[str, Any]], tree: Any,
                     and (pred is None or pred(tuple(shape))):
                 fired[idx] += 1
                 claimed_by[name] = idx
+                leaf_spec = resolve_spec(spec, shape)
+                if callable(spec):
+                    builder_axes.setdefault(idx, set()).update(
+                        a for _, axes in _spec_partitions(leaf_spec)
+                        for a in axes)
                 findings.extend(_spec_findings(
-                    spec, name, shape, sizes,
+                    leaf_spec, name, shape, sizes,
                     rule_label=f"rule[{idx}] {pat!r}"))
                 break
         else:
@@ -217,6 +232,19 @@ def audit_rules(rules: Sequence[Tuple[str, Any]], tree: Any,
                 message=f"rule[{idx}] {pat!r} fires on no leaf of the "
                         "audited tree — stale path or typo'd pattern",
             ))
+    if sizes is not None:
+        for idx, axes in sorted(builder_axes.items()):
+            missing = sorted(a for a in axes if a not in sizes)
+            if missing:
+                findings.append(Finding(
+                    rule=RULE_UNKNOWN_AXIS, severity=ERROR,
+                    path=f"rule[{idx}]",
+                    message=f"rule[{idx}] {compiled[idx][1]!r} "
+                            f"(spec builder) resolved specs naming mesh "
+                            f"ax{'es' if len(missing) > 1 else 'is'} "
+                            f"{missing} absent from the target mesh "
+                            f"(have {sorted(sizes)})",
+                ))
     return findings
 
 
@@ -239,7 +267,11 @@ def tp_rule_gaps(tree: Any, rules: Optional[Sequence[Tuple[str, Any]]] = None,
     """
     from jax.sharding import PartitionSpec as P
 
-    from p2p_tpu.parallel.rules import REPLICATED_RULES, rule_parts
+    from p2p_tpu.parallel.rules import (
+        REPLICATED_RULES,
+        resolve_spec,
+        rule_parts,
+    )
     from p2p_tpu.parallel.tp import tp_leaf_spec
 
     rules = REPLICATED_RULES if rules is None else rules
@@ -257,7 +289,7 @@ def tp_rule_gaps(tree: Any, rules: Optional[Sequence[Tuple[str, Any]]] = None,
         for cre, spec, pred in compiled:
             if cre.search(name) is not None \
                     and (pred is None or pred(tuple(shape))):
-                rule_spec = spec
+                rule_spec = resolve_spec(spec, shape)
                 break
         if rule_spec is None or tuple(tp_spec) == tuple(rule_spec):
             continue  # unmatched leaves are audit_rules' finding, not a gap
@@ -269,7 +301,7 @@ def tp_rule_gaps(tree: Any, rules: Optional[Sequence[Tuple[str, Any]]] = None,
         })
         findings.append(Finding(
             rule=RULE_TP_GAP, severity=INFO, path=name,
-            message=f"tp_sharding_tree says {tp_spec}, rule table says "
+            message=f"tp_leaf_spec says {tp_spec}, rule table says "
                     f"{rule_spec} (shape {shape}) — {direction}",
         ))
     return worklist, findings

@@ -72,9 +72,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "delayed-int8 checkpoints additionally serve with "
                         "frozen activation scales)")
     p.add_argument("--mesh", type=str, default=None,
-                   help="serving mesh 'data,spatial,time[,model]': "
-                        "model>1 shards the generator tensor-parallel "
-                        "(parallel/tp.py)")
+                   help="serving mesh: positional 'data,spatial,time"
+                        "[,model]' or named 'axis=size,...'; model>1 "
+                        "shards the generator tensor-parallel "
+                        "(parallel/rules.py)")
     p.add_argument("--tp_min_ch", type=int, default=None,
                    help="smallest channel count the TP rule shards")
     p.add_argument("--io_threads", type=int, default=4,
@@ -92,20 +93,16 @@ def build_parser() -> argparse.ArgumentParser:
 def _parse_mesh(arg):
     if arg is None:
         return None
-    from p2p_tpu.core.mesh import MeshSpec, make_mesh
+    from p2p_tpu.core.mesh import make_mesh, parse_mesh_arg
 
     try:
-        vals = [int(v) for v in arg.split(",")]
-        if not 3 <= len(vals) <= 5:
-            raise ValueError("need 3-5 axes")
-        while len(vals) < 5:
-            vals.append(1)
-        d, s, t, m, pp = vals
-    except ValueError:
+        spec = parse_mesh_arg(arg)
+    except ValueError as e:
         raise SystemExit(
             f"--mesh must be 'data,spatial,time[,model[,pipe]]' "
-            f"comma-separated ints (got {arg!r})")
-    return make_mesh(MeshSpec(data=d, spatial=s, time=t, model=m, pipe=pp))
+            f"comma-separated ints or named 'axis=size,...' (got "
+            f"{arg!r}: {e})")
+    return make_mesh(spec)
 
 
 def main(argv=None) -> int:

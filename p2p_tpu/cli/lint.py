@@ -13,12 +13,13 @@ unwaived finding:
 3. **Concurrency race lint** (analysis/concurrency_lint): signal-handler
    reentrancy, unlocked shared-state mutation in lock-owning classes,
    atexit-vs-thread shutdown ordering.
-4. **Sharding audit**: the declarative rule tables (parallel/rules.py)
-   statically verified against full-size preset TrainStates built
-   shape-only via ``jax.eval_shape``. The facades family audits against
-   its PREDICATE-rule TP table (zero tp-diff gaps — drained); the
-   remaining families still diff against the replicated table, feeding
-   the ROADMAP item-3 worklist (info severity).
+4. **Sharding audit**: the declarative rule tables (parallel/rules.py —
+   THE partitioner for the whole TrainState since ISSUE 15) statically
+   verified against full-size preset TrainStates built shape-only via
+   ``jax.eval_shape``. Every family audits against its predicate-rule
+   TP table (zero tp-diff gaps — drained) AND against the composed
+   TP+FSDP table on an fsdp-bearing mesh; dead/shadowed fsdp rules fail
+   like any other.
 5. **Memory audit** (analysis/memory_audit): donation markers on the
    lowered train steps (a declared-donated leaf with no alias/donor
    marker is copied, not donated), the serving dead-restore check, and —
@@ -43,7 +44,7 @@ unwaived finding:
    mirroring ``--tp-diff``). ISSUE 14 DRAINED the worklist: it audits
    the full-coverage program (``train_step[facades_int8_full]`` =
    ``core.config.int8_full_coverage``, the same override set the
-   ``BENCH_INT8_FULL`` bench row measures) where every conv/dot is
+   ``facades_int8_full`` sweep row measures) where every conv/dot is
    either quantized or carries a dated in-source waiver (measured-
    rejected stems/head, per-form dispatch-table backward islands) — CI
    asserts "0 sites" so a lost quantized route or an unknobbed new
@@ -166,8 +167,11 @@ AUDIT_PRESETS = ("facades", "facades_int8", "edges2shoes_dp",
 
 def run_sharding_audit(report, tp_axis_size: int, tp_min_ch: int):
     """Audit each preset against ITS rule table (family TP tables where
-    drained, replicated elsewhere); returns the remaining tp-diff
-    worklist."""
+    drained, replicated elsewhere) AND against the composed TP+FSDP
+    table on an fsdp mesh (ISSUE 15 — dead/shadowed fsdp rules are lint
+    errors like any other); returns the remaining tp-diff worklist."""
+    from jax.sharding import PartitionSpec as P
+
     from p2p_tpu.analysis.sharding_audit import (
         abstract_train_state,
         audit_rules,
@@ -176,12 +180,13 @@ def run_sharding_audit(report, tp_axis_size: int, tp_min_ch: int):
     from p2p_tpu.core.config import get_preset
     from p2p_tpu.parallel.rules import (
         REPLICATED_RULES,
+        make_fsdp_rules,
         tp_equivalence_rules,
     )
 
     # the hypothetical target topology: every axis the mesh vocabulary
     # names, sized so divisibility is actually exercised (no devices)
-    mesh = {"data": 8, "spatial": 2, "time": 1,
+    mesh = {"data": 8, "fsdp": 2, "spatial": 2, "time": 1,
             "model": tp_axis_size, "pipe": 2}
     worklist = []
     for preset in AUDIT_PRESETS:
@@ -190,6 +195,13 @@ def run_sharding_audit(report, tp_axis_size: int, tp_min_ch: int):
             or REPLICATED_RULES
         state = abstract_train_state(cfg)
         report.extend(audit_rules(rules, state, mesh))
+        # the composed layout the fsdp trainers actually run: the
+        # family's TP pairs first, then the ZeRO state rules (params
+        # included — the stricter table), then the catch-all
+        fsdp_rules = (rules[:-1]
+                      + make_fsdp_rules(2, fsdp_params=True)
+                      + ((r".*", P()),))
+        report.extend(audit_rules(fsdp_rules, state, mesh))
         wl, findings = tp_rule_gaps(state, rules=rules,
                                     axis_size=tp_axis_size,
                                     min_ch=tp_min_ch)
@@ -393,7 +405,7 @@ def _int8_train_program(full: bool = False):
 
     ``full=True`` traces the FULL-COVERAGE variant
     (``core.config.int8_full_coverage`` — every ISSUE-14 knob on, the
-    same override set ``bench.py``'s ``BENCH_INT8_FULL`` row measures):
+    same override set ``bench.py``'s ``facades_int8_full`` row measures):
     the program the drained int8-coverage worklist audits. The plain
     variant stays the roofline row for the shipping preset (the headline
     bench row's program)."""

@@ -95,7 +95,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "restore the EMA generator weights and serve the "
                         "SMOOTHED G (bitwise == raw at decay 0)")
     p.add_argument("--mesh", type=str, default=None,
-                   help="serving mesh 'data,spatial,time[,model]'")
+                   help="serving mesh: positional 'data,spatial,time"
+                        "[,model]' or named 'axis=size,...'")
     p.add_argument("--tp_min_ch", type=int, default=None)
     p.add_argument("--io_threads", type=int, default=4)
     p.add_argument("--compilation_cache", type=str, default=None,

@@ -29,13 +29,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workdir", type=str, default=".",
                    help="checkpoints/results/metrics land here")
     p.add_argument("--mesh", type=str, default=None,
-                   help="mesh axes 'data,spatial,time[,model[,pipe]]' e.g. "
-                        "'4,2,1' (data may be -1 = all remaining devices); "
-                        "model>1 trains tensor-parallel (docs/PARALLELISM.md)")
+                   help="mesh axes: positional "
+                        "'data,spatial,time[,model[,pipe]]' (e.g. '4,2,1') "
+                        "or named 'axis=size,...' over data/fsdp/spatial/"
+                        "time/model/pipe (e.g. 'data=4,fsdp=2,model=2'; "
+                        "data may be -1 = all remaining devices); model>1 "
+                        "trains tensor-parallel, fsdp>1 shards optimizer+"
+                        "EMA state ZeRO-style (docs/PARALLELISM.md)")
     p.add_argument("--tp_min_ch", type=int, default=None,
                    help="smallest channel count the TP pair rule shards "
                         "over the model axis (ParallelConfig.tp_min_ch; "
                         "default 512 — lower it only for toy models)")
+    p.add_argument("--fsdp_params", action="store_true", default=None,
+                   help="with mesh fsdp>1: shard the params themselves "
+                        "over the fsdp axis too (ZeRO-3-ish gather-on-"
+                        "use), not just optimizer moments + EMA "
+                        "(ParallelConfig.fsdp_params)")
     p.add_argument("--image_width", type=int, default=None,
                    help="image width when not square (e.g. pix2pixhd "
                         "1024x512 trains height=512 width=1024)")
@@ -322,29 +331,20 @@ def config_from_flags(args: argparse.Namespace) -> Config:
                   spike_zscore=args.spike_zscore,
                   cooldown_steps=args.cooldown_steps,
                   window=args.health_window)
-    par = over(par, tp_min_ch=args.tp_min_ch, pp_overlap=args.pp_overlap)
+    par = over(par, tp_min_ch=args.tp_min_ch, pp_overlap=args.pp_overlap,
+               fsdp_params=args.fsdp_params)
     if args.mesh is not None:
-        from p2p_tpu.core.mesh import MeshSpec
+        from p2p_tpu.core.mesh import parse_mesh_arg
 
         try:
-            vals = [int(v) for v in args.mesh.split(",")]
-            if len(vals) < 3:   # only model/pipe are optional
-                raise ValueError("too few axes")
-            while len(vals) < 5:
-                vals.append(1)
-            d, s, t, m, pp = vals
-        except ValueError:
+            spec = parse_mesh_arg(args.mesh)
+        except ValueError as e:
             raise SystemExit(
                 f"--mesh must be 'data,spatial,time[,model[,pipe]]' "
-                f"comma-separated ints (got {args.mesh!r})"
+                f"comma-separated ints or named 'axis=size,...' (got "
+                f"{args.mesh!r}: {e})"
             )
-        if s < 1 or t < 1 or m < 1 or pp < 1 or (d < 1 and d != -1):
-            raise SystemExit(
-                "--mesh axes must be >=1 (data may be -1 = all remaining "
-                f"devices); got {args.mesh!r}"
-            )
-        par = dataclasses.replace(
-            par, mesh=MeshSpec(data=d, spatial=s, time=t, model=m, pipe=pp))
+        par = dataclasses.replace(par, mesh=spec)
     name = args.name or cfg.name
     cfg = dataclasses.replace(
         cfg, name=name, model=model, loss=loss, optim=optim, data=data,

@@ -106,7 +106,7 @@ class ModelConfig:
     # net_c's k5 RGB conv) on the int8 path. Off by default: the stems
     # are HBM-bound (the MXU gains nothing on a 3-wide contraction) —
     # the round-2..5 doctrine — but the knob keeps the form measurable
-    # per chip/shape (BENCH_INT8_FULL flips it for the band-pending row).
+    # per chip/shape (even the facades_int8_full row keeps it off).
     int8_stem: bool = False
     # Discriminator logits head on the int8 path: the kn2row-eligible
     # 512→1 head runs the s8×s8→s32 tap-decomposition dot
@@ -247,10 +247,18 @@ class DataConfig:
 class ParallelConfig:
     mesh: MeshSpec = MeshSpec(data=-1, spatial=1, time=1)
     # Tensor parallelism (mesh.model > 1): smallest channel count the
-    # Megatron pair rule shards (parallel/tp.py tp_sharding_tree). 512
+    # Megatron pair rule shards (parallel/rules.py make_tp_rules). 512
     # keeps the narrow layers replicated where a psum would cost more
     # than the shard saves; tests/dryruns lower it so tiny models shard.
     tp_min_ch: int = 512
+    # With mesh.fsdp > 1: extend the ZeRO state sharding from the
+    # optimizer moments + EMA (always sharded over the fsdp axis —
+    # parallel/rules.py make_fsdp_rules) to the params themselves
+    # (ZeRO-3-ish). Off by default: the param all-gather then sits on
+    # every forward's critical path, which only pays once params
+    # themselves blow the HBM budget; moments+EMA are ~2/3 of the state
+    # bytes (memory_budget.json) and shard free of that trade.
+    fsdp_params: bool = False
     # Sync batch-norm statistics across the data axis (pmean). At bs=1 per
     # device this is the only way BatchNorm matches reference semantics.
     sync_batchnorm: bool = True
@@ -536,7 +544,7 @@ def int8_full_coverage(cfg: Config) -> Config:
 
     Shared by the lint CLI (the ``train_step[facades_int8_full]`` traced
     program the coverage worklist audits) and ``bench.py``'s
-    ``BENCH_INT8_FULL`` band-pending sweep row, so the statically audited
+    ``facades_int8_full`` band-pending sweep row, so the statically audited
     program and the measured one can never drift apart. Deliberately NOT
     flipped: ``int8_stem`` (HBM-bound 3/6-ch stems — the measured-rejected
     verdict carried by dated in-source waivers) and the U-Net image head
@@ -554,6 +562,16 @@ def int8_full_coverage(cfg: Config) -> Config:
             int8_compression=True,
         ),
     )
+
+
+# The full-coverage int8 config as a FIRST-CLASS preset (ISSUE 15): the
+# on-TPU measurement of record for the ROADMAP item-2 band decision rides
+# the default sweep as a plain --preset/BENCH_PRESET row — no opt-out env
+# gate between the measurement and the round. Same override set the lint
+# CLI traces as train_step[facades_int8_full], so the static and measured
+# programs still cannot drift.
+_register(int8_full_coverage(_PRESETS["facades_int8"]).replace(
+    name="facades_int8_full"))
 
 
 def list_presets():

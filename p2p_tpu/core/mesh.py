@@ -1,9 +1,13 @@
 """Device mesh construction — the substrate for every parallelism strategy.
 
-The framework uses one global ``jax.sharding.Mesh`` with up to three named
-axes:
+The framework uses one global ``jax.sharding.Mesh`` with named axes:
 
 - ``data``    data parallelism (per-device batch shards, gradient psum)
+- ``fsdp``    ZeRO-style state sharding (parallel/rules.py): batches shard
+              over it exactly like ``data``, but optimizer moments / EMA
+              (and, behind ``ParallelConfig.fsdp_params``, params) are
+              PARTITIONED over it instead of replicated — gather-on-use
+              is GSPMD's job via the pjit in/out shardings
 - ``spatial`` GSPMD spatial sharding of the image H dimension (large images;
               conv halo exchange handled in ``p2p_tpu.parallel.spatial``)
 - ``time``    temporal sequence parallelism for video discriminators
@@ -30,11 +34,17 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"     # ZeRO state sharding: moments/EMA/params (parallel/rules.py)
 SPATIAL_AXIS = "spatial"
 TIME_AXIS = "time"
 MODEL_AXIS = "model"   # tensor parallelism: conv channel dims (parallel/tp.py)
 PIPE_AXIS = "pipe"     # pipeline parallelism: trunk stages (parallel/pp.py)
-ALL_AXES = (DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS, PIPE_AXIS)
+ALL_AXES = (DATA_AXIS, FSDP_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS,
+            PIPE_AXIS)
+#: the axes a batch's leading (N) dimension shards over — fsdp devices
+#: see distinct samples exactly like data devices; only the STATE layout
+#: differs between the two axes
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
 
 
 # --------------------------------------------------------------- jax compat
@@ -80,37 +90,90 @@ class MeshSpec:
     time: int = 1
     model: int = 1   # tensor-parallel axis (channel dims; parallel/tp.py)
     pipe: int = 1    # pipeline-parallel axis (trunk stages; parallel/pp.py)
+    fsdp: int = 1    # ZeRO state-sharding axis (parallel/rules.py)
 
     def resolve(self, n_devices: int,
-                context: str = "") -> tuple[int, int, int, int, int]:
-        """Concrete per-axis sizes for ``n_devices``.
+                context: str = "") -> tuple[int, int, int, int, int, int]:
+        """Concrete per-axis sizes ``(data, fsdp, spatial, time, model,
+        pipe)`` for ``n_devices``.
 
         ``context`` (optional) is appended to the failure diagnostics —
         the elastic-relaunch path passes the topology the checkpoint was
         saved on, so "my relaunch flags don't fit this slice" reads as
         exactly that instead of a bare divisibility error.
         """
-        d, s, t, m, p = (self.data, self.spatial, self.time, self.model,
-                         self.pipe)
-        fixed = s * t * m * p
+        d, f, s, t, m, p = (self.data, self.fsdp, self.spatial, self.time,
+                            self.model, self.pipe)
+        fixed = f * s * t * m * p
         suffix = f"; {context}" if context else ""
         if d == -1:
             if n_devices % fixed:
                 raise ValueError(
-                    f"mesh data=-1,spatial={s},time={t},model={m},pipe={p} "
-                    f"cannot resolve: {n_devices} device(s) not divisible "
-                    f"by spatial*time*model*pipe={fixed} — pick axes whose "
-                    f"product divides the device count{suffix}"
+                    f"mesh data=-1,fsdp={f},spatial={s},time={t},model={m},"
+                    f"pipe={p} cannot resolve: {n_devices} device(s) not "
+                    f"divisible by fsdp*spatial*time*model*pipe={fixed} — "
+                    f"pick axes whose product divides the device "
+                    f"count{suffix}"
                 )
             d = n_devices // fixed
-        if d * s * t * m * p > n_devices:
+        if d * fixed > n_devices:
             raise ValueError(
-                f"mesh data={d},spatial={s},time={t},model={m},pipe={p} "
-                f"needs {d * s * t * m * p} devices but only {n_devices} "
+                f"mesh data={d},fsdp={f},spatial={s},time={t},model={m},"
+                f"pipe={p} needs {d * fixed} devices but only {n_devices} "
                 f"are available — shrink an axis or use data=-1 (all "
                 f"remaining devices){suffix}"
             )
-        return d, s, t, m, p
+        return d, f, s, t, m, p
+
+
+def parse_mesh_arg(text: str) -> MeshSpec:
+    """The ``--mesh`` flag grammar, shared by every CLI.
+
+    Two forms:
+
+    - positional (legacy): ``data,spatial,time[,model[,pipe]]``
+      comma-separated ints — ``2,1,1,2`` is data=2 × model=2;
+    - named: ``axis=size[,axis=size...]`` over the full vocabulary
+      (``data``/``fsdp``/``spatial``/``time``/``model``/``pipe``), any
+      order, unnamed axes default to 1 (data to -1 when omitted) —
+      ``data=4,fsdp=2,model=2``. The named form is the only way to
+      address the ``fsdp`` axis.
+
+    Raises ``ValueError`` with the offending text; CLIs turn that into
+    their usage error.
+    """
+    text = text.strip()
+    if "=" in text:
+        sizes = {}
+        for part in text.split(","):
+            if not part.strip():
+                continue
+            key, _, val = part.partition("=")
+            key = key.strip()
+            if key not in ALL_AXES:
+                raise ValueError(
+                    f"unknown mesh axis {key!r} (have {ALL_AXES})")
+            if key in sizes:
+                raise ValueError(f"mesh axis {key!r} named twice")
+            sizes[key] = int(val)
+        spec = MeshSpec(data=sizes.pop(DATA_AXIS, -1), **sizes)
+    else:
+        vals = [int(v) for v in text.split(",")]
+        if len(vals) < 3:   # only model/pipe are optional
+            raise ValueError("too few axes")
+        while len(vals) < 5:
+            vals.append(1)
+        if len(vals) > 5:
+            raise ValueError("too many axes (use the named form for fsdp)")
+        d, s, t, m, p = vals
+        spec = MeshSpec(data=d, spatial=s, time=t, model=m, pipe=p)
+    for axis in ALL_AXES:
+        size = getattr(spec, axis)
+        if size < 1 and not (axis == DATA_AXIS and size == -1):
+            raise ValueError(
+                f"mesh axis {axis}={size}: axes must be >=1 (data may be "
+                "-1 = all remaining devices)")
+    return spec
 
 
 class TopologyMismatch(ValueError):
@@ -151,10 +214,10 @@ class TopologyDelta:
 
     ``kind``:
     - ``"same"``    identical topology — the plain exact-step resume path
-    - ``"reshard"`` a compatible delta (process count, data/spatial/time
-      axis widths, device count): restore proceeds with target shardings
-      derived for the NEW mesh, and the per-host data skip re-derives
-      from the global step
+    - ``"reshard"`` a compatible delta (process count, data/fsdp/
+      spatial/time axis widths, device count): restore proceeds with
+      target shardings derived for the NEW mesh, and the per-host data
+      skip re-derives from the global step
     - ``"migrate"`` a delta that is lawful only THROUGH a restore-time
       state transform (p2p_tpu.resilience.reshape): ``chain`` names the
       transforms, in application order — ``batch_rebase`` (global-batch
@@ -204,7 +267,10 @@ def classify_topology_delta(saved: dict, current: dict,
     - any other mesh-axis / process-count / device-count change →
       reshard (params are replicated or rule-resharded over these axes;
       the input pipeline re-derives per-host shards from the global
-      step).
+      step). The ``fsdp`` axis deliberately rides this row: an
+      fsdp↔replicated delta is a pure LAYOUT change — the Orbax load
+      lands the moments/EMA on the new mesh's rule-derived target
+      shardings (parallel/rules.py), no state transform needed.
 
     Keys absent from ``saved`` (older sidecars) are treated as matching —
     forward-compatible by construction.
@@ -301,19 +367,20 @@ def make_mesh(
 ) -> Mesh:
     """Build the global mesh.
 
-    Axis order is (data, spatial, time, model, pipe) with data outermost: JAX
-    lays devices out so the *innermost* axes are nearest-neighbor on the ICI
-    torus, which is where the bandwidth-hungry halo exchanges (spatial), ring
-    shifts (time), and pipeline stage hand-offs (pipe: neighbor ppermute every
-    tick) live; data-parallel all-reduces tolerate the longer hops.
+    Axis order is (data, fsdp, spatial, time, model, pipe) with data
+    outermost: JAX lays devices out so the *innermost* axes are
+    nearest-neighbor on the ICI torus, which is where the bandwidth-hungry
+    halo exchanges (spatial), ring shifts (time), and pipeline stage
+    hand-offs (pipe: neighbor ppermute every tick) live; data-parallel
+    all-reduces tolerate the longer hops. ``fsdp`` sits right under
+    ``data``: its param/moment all-gathers and reduce-scatters are the
+    next-chattiest collectives after the inner-axis exchanges.
     """
     devices = list(devices if devices is not None else jax.devices())
-    d, s, t, m, p = spec.resolve(len(devices))
-    dev_array = np.asarray(devices[: d * s * t * m * p]).reshape(d, s, t, m, p)
-    return Mesh(
-        dev_array,
-        axis_names=(DATA_AXIS, SPATIAL_AXIS, TIME_AXIS, MODEL_AXIS, PIPE_AXIS),
-    )
+    d, f, s, t, m, p = spec.resolve(len(devices))
+    n = d * f * s * t * m * p
+    dev_array = np.asarray(devices[:n]).reshape(d, f, s, t, m, p)
+    return Mesh(dev_array, axis_names=ALL_AXES)
 
 
 def single_device_mesh() -> Mesh:
@@ -336,13 +403,17 @@ def distributed_init(
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Canonical sharding for NHWC image batches: N over data, H over spatial."""
-    return NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS, None, None))
+    """Canonical sharding for NHWC image batches: N over (data, fsdp) —
+    fsdp devices consume distinct samples like data devices — H over
+    spatial."""
+    return NamedSharding(mesh, P(BATCH_AXES, SPATIAL_AXIS, None, None))
 
 
 def video_sharding(mesh: Mesh) -> NamedSharding:
-    """NTHWC video batches: N over data, T over time, H over spatial."""
-    return NamedSharding(mesh, P(DATA_AXIS, TIME_AXIS, SPATIAL_AXIS, None, None))
+    """NTHWC video batches: N over (data, fsdp), T over time, H over
+    spatial."""
+    return NamedSharding(
+        mesh, P(BATCH_AXES, TIME_AXIS, SPATIAL_AXIS, None, None))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -60,7 +60,7 @@ class UNetGenerator(nn.Module):
     # measured-rejected verdict: the 3-wide contraction leaves the MXU
     # idle either way (the stem is HBM-bound; see the dated waiver at
     # the down_conv site) — but the knob keeps the form measurable per
-    # chip/shape (BENCH_INT8_FULL does not flip it).
+    # chip/shape (the facades_int8_full preset does not flip it).
     int8_stem: bool = False
     # Keep the (mathematically dead) conv biases in front of norm layers.
     # A per-channel bias immediately followed by a mean-subtracting norm

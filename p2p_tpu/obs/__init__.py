@@ -54,7 +54,12 @@ from p2p_tpu.obs.taps import (
     remove_sentinel_handler,
 )
 from p2p_tpu.obs.timing import StepTimer, measure_rtt
-from p2p_tpu.obs.watchdogs import MemoryWatchdog, RetraceWatchdog
+from p2p_tpu.obs.watchdogs import (
+    MemoryWatchdog,
+    RetraceWatchdog,
+    budget_drift,
+    crosscheck_hbm_budget,
+)
 
 __all__ = [
     "Counter",
@@ -67,6 +72,8 @@ __all__ = [
     "MetricsRegistry",
     "PrometheusTextfileSink",
     "RetraceWatchdog",
+    "budget_drift",
+    "crosscheck_hbm_budget",
     "Sink",
     "SpanRecorder",
     "StdoutSink",

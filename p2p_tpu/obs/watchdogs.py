@@ -159,3 +159,73 @@ class MemoryWatchdog:
             logger.log({"kind": "memory", "n_devices": len(out), **worst},
                        force=True)
         return out
+
+
+#: tolerated |live − static| / static before the startup cross-check
+#: warns — past this the static memory model (memory_budget.json) has
+#: rotted relative to what the runtime actually allocates
+HBM_BUDGET_DRIFT = 0.10
+
+
+def budget_drift(live_bytes: int, static_bytes: int,
+                 tolerance: float = HBM_BUDGET_DRIFT):
+    """``(drift_fraction, out_of_band)`` for a live-vs-static byte pair —
+    the pure comparison behind :func:`crosscheck_hbm_budget`, unit-tested
+    without a TPU."""
+    if static_bytes <= 0:
+        return 0.0, False
+    drift = abs(int(live_bytes) - int(static_bytes)) / float(static_bytes)
+    return drift, drift > tolerance
+
+
+def crosscheck_hbm_budget(cfg, mesh, registry=None, logger=None,
+                          samples=None, extra_bytes: int = 0):
+    """Startup cross-check (ISSUE 15): the live per-host HBM fill
+    (``Device.memory_stats``) against the static ``memory_budget.json``
+    state law (``analysis/memory_audit.state_budget`` over the SAME rule
+    tables the trainer placed the state with). Call right after state
+    placement, before the first step compiles — at that point the device
+    holds essentially the TrainState, so live-vs-static is a direct test
+    of the static model.
+
+    ``extra_bytes`` covers device residents the state law does not model
+    (the trainer passes its VGG feature tree — loaded before this check
+    runs, so it is part of the honest baseline, not drift).
+
+    Publishes ``hbm_budget_state_bytes`` / ``hbm_budget_live_bytes``
+    gauges and a ``kind="hbm_budget"`` record; WARNS (and counts
+    ``hbm_budget_drift_total``) past :data:`HBM_BUDGET_DRIFT`. Returns
+    the record, or None on backends that report no memory stats (CPU
+    CI)."""
+    if samples is None:
+        samples = MemoryWatchdog(registry).sample()
+    if not samples:
+        return None          # CPU/test backend: nothing to cross-check
+    from p2p_tpu.analysis.memory_audit import state_budget
+
+    sizes = {str(a): int(s) for a, s in dict(mesh.shape).items()} \
+        if mesh is not None else {}
+    static = state_budget(cfg, sizes, tp_min_ch=cfg.parallel.tp_min_ch,
+                          fsdp_params=cfg.parallel.fsdp_params)
+    expected = int(static["state_total"]) + int(extra_bytes)
+    live = max(int(s.get("bytes_in_use", 0)) for s in samples.values())
+    drift, out_of_band = budget_drift(live, expected)
+    rec = {"kind": "hbm_budget", "static_state_bytes": expected,
+           "extra_bytes": int(extra_bytes),
+           "live_bytes_in_use": live, "drift": round(drift, 4),
+           "out_of_band": out_of_band, "mesh": sizes}
+    if registry is not None:
+        registry.gauge("hbm_budget_state_bytes").set(expected)
+        registry.gauge("hbm_budget_live_bytes").set(live)
+        if out_of_band:
+            registry.counter("hbm_budget_drift_total").inc()
+    if logger is not None:
+        logger.log(rec, force=True)
+    if out_of_band:
+        print(f"WARNING: live HBM {live / (1 << 20):.1f} MiB vs static "
+              f"state budget {expected / (1 << 20):.1f} MiB — "
+              f"{drift * 100:.1f}% drift (> {HBM_BUDGET_DRIFT * 100:.0f}%)"
+              " — the static memory model (memory_budget.json law) no "
+              "longer matches the runtime; re-derive it before trusting "
+              "budget rows", flush=True)
+    return rec

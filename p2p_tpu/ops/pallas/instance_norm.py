@@ -84,7 +84,7 @@ def sharded_pallas_instance_norm(
     from jax.sharding import PartitionSpec as P
 
     from p2p_tpu.core.mesh import (
-        DATA_AXIS,
+        BATCH_AXES,
         SPATIAL_AXIS,
         shard_map_compat as shard_map,
     )
@@ -92,7 +92,9 @@ def sharded_pallas_instance_norm(
         instance_norm_fused_sharded,
     )
 
-    x_spec = P(DATA_AXIS, SPATIAL_AXIS, None, None)
+    # N splits over (data, fsdp) — core/mesh.batch_sharding; instance
+    # stats are per-sample so only the spatial psum crosses devices
+    x_spec = P(BATCH_AXES, SPATIAL_AXIS, None, None)
     if scale is None:
         fn = shard_map(
             lambda xl: instance_norm_fused_sharded(
@@ -111,13 +113,16 @@ def sharded_pallas_instance_norm(
 
 
 def _sharding_mesh_for(x: jax.Array):
-    """The active mesh when x is shardable over (data, spatial), else None."""
-    from p2p_tpu.core.mesh import DATA_AXIS, SPATIAL_AXIS, current_mesh
+    """The active mesh when x is shardable over (data×fsdp, spatial),
+    else None."""
+    from p2p_tpu.core.mesh import BATCH_AXES, SPATIAL_AXIS, current_mesh
 
     mesh = current_mesh()
     if mesh is None:
         return None
-    d = mesh.shape.get(DATA_AXIS, 1)
+    d = 1
+    for a in BATCH_AXES:
+        d *= mesh.shape.get(a, 1)
     s = mesh.shape.get(SPATIAL_AXIS, 1)
     if s <= 1:
         return None

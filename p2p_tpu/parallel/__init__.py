@@ -1,9 +1,15 @@
 """Parallelism strategies over the global device mesh (SURVEY.md §2.4).
 
+- ``rules``    THE declarative sharding authority (ISSUE 15): one
+               regex-over-named-tree rule table produces the layout of
+               the whole TrainState — Megatron TP pair shards over
+               ``model``, ZeRO optimizer/EMA(/param) shards over
+               ``fsdp``, replicate floor.
 - ``dp``       data parallelism (+ mixed data×spatial) via sharding
                annotations on the jitted train step; GSPMD collectives.
 - ``tp``       tensor parallelism: Megatron-style channel shards on the
-               ResNet trunk's conv pairs over the ``model`` mesh axis.
+               ResNet trunk's conv pairs over the ``model`` mesh axis
+               (the tree builder is a shim over ``rules``).
 - ``spatial``  GSPMD spatial sharding of H with explicit shard_map halo
                exchange for the stride-1 conv trunk.
 - ``temporal`` sequence parallelism over video frames for the vid2vid
@@ -35,6 +41,13 @@ from p2p_tpu.parallel.pp import (
     pp_split_state,
     stack_trunk,
 )
+from p2p_tpu.parallel.rules import (
+    make_fsdp_rules,
+    make_tp_rules,
+    match_partition_rules,
+    state_target_shardings,
+    trainstate_rules,
+)
 from p2p_tpu.parallel.tp import place_state_tp, tp_sharding_tree
 from p2p_tpu.parallel.spatial import (
     check_spatial_divisible,
@@ -64,6 +77,11 @@ __all__ = [
     "pp_generator_forward",
     "pp_split_state",
     "stack_trunk",
+    "make_fsdp_rules",
+    "make_tp_rules",
+    "match_partition_rules",
+    "state_target_shardings",
+    "trainstate_rules",
     "place_state_tp",
     "tp_sharding_tree",
     "ring_shift",

@@ -68,8 +68,9 @@ def make_parallel_train_step(
     all GSPMD-inserted.
 
     ``state_sharding``: optional NamedSharding pytree for the TrainState
-    (e.g. ``parallel.tp.tp_sharding_tree`` for tensor parallelism over the
-    ``model`` axis); defaults to fully replicated.
+    (``parallel.rules.state_target_shardings`` — Megatron TP over
+    ``model``, ZeRO moments/EMA over ``fsdp``); defaults to fully
+    replicated.
     """
     step = build_train_step(
         cfg, vgg_params, steps_per_epoch, train_dtype, jit=False
@@ -108,7 +109,7 @@ def make_parallel_multi_train_step(
     ``P(None, 'data', 'spatial', None, None)``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from p2p_tpu.core.mesh import DATA_AXIS, SPATIAL_AXIS
+    from p2p_tpu.core.mesh import BATCH_AXES, SPATIAL_AXIS
 
     inner = build_train_step(
         cfg, vgg_params, steps_per_epoch, train_dtype, jit=False
@@ -120,7 +121,7 @@ def make_parallel_multi_train_step(
 
     rep = replicated(mesh)
     stacked_bsh = NamedSharding(
-        mesh, P(None, DATA_AXIS, SPATIAL_AXIS, None, None))
+        mesh, P(None, BATCH_AXES, SPATIAL_AXIS, None, None))
     ssh = rep if state_sharding is None else state_sharding
     return jax.jit(
         multi_step,

@@ -1,30 +1,46 @@
-"""Rule-driven partition-spec derivation over named state trees.
+"""Rule-driven partition-spec derivation over named state trees — THE
+sharding authority for the whole TrainState (ROADMAP item 3, closed by
+ISSUE 15).
 
-The seed of the declarative sharding-rule engine (ROADMAP item 3, the
-regex-over-named-tree ``match_partition_rules`` pattern of SNIPPETS [1]/
-[2]): ONE ordered rule table — ``(regex, PartitionSpec)`` pairs matched
-against slash-joined leaf paths — produces the PartitionSpec tree for an
-arbitrary pytree (params, optimizer moments, or a whole TrainState; adam's
-mu/nu mirror the param paths, so one param rule covers all three).
+The regex-over-named-tree ``match_partition_rules`` pattern of SNIPPETS
+[1]/[2]: ONE ordered rule table matched against slash-joined leaf paths
+produces the PartitionSpec tree for an arbitrary pytree (params,
+optimizer moments, EMA, or a whole TrainState; adam's mu/nu mirror the
+param paths, so one param rule covers all three). Every live layout —
+CLI trainer placement, serving-engine placement, the elastic restore
+targets, the static memory budget — derives from
+:func:`state_target_shardings` over :func:`trainstate_rules`; the old
+hand-built TP tree builder in ``parallel/tp.py`` is a thin shim over
+these tables (a CI grep gate keeps it that way).
 
-**Predicate rules** (the item-3 migration mechanism): a rule may carry a
-third element, ``predicate(shape) -> bool`` — the rule fires only when its
-regex matches AND the predicate accepts the leaf shape. This is exactly
-the expressive gap the tp-diff worklist names ``needs-predicate-rule``:
-the hand-built TP assignment (parallel/tp.py) gates every shard on
-channel width and divisibility, which a bare regex cannot see.
-:func:`make_unet_tp_rules` / :func:`make_patchgan_tp_rules` use it to
-reproduce ``tp_leaf_spec`` declaratively for the facades (U-Net +
-PatchGAN) family — the first family drained from the worklist; the
-ResNet/pix2pixHD trunks are the remaining entries.
+Rule entries, first ``re.search`` match wins:
 
-First consumer: the elastic resharded-resume path (train/loop.py
-``plan_elastic_restore``). A relaunch on a different slice derives the
-checkpoint's **target shardings for the NEW mesh** from rules instead of
-from the dead run's layout — today the table is narrow (replicate
-everything; Megatron channel shards via the TP pair rule when the model
-axis is real), but the derivation is already the single place a future
-FSDP/ZeRO rule-set plugs into.
+- ``(regex, PartitionSpec)``;
+- ``(regex, PartitionSpec, predicate)`` — **predicate rules**: fires only
+  when ``predicate(shape)`` also accepts the leaf shape (the TP tables
+  gate every channel shard on width/divisibility, which a bare regex
+  cannot see);
+- ``(regex, spec_builder)`` where ``spec_builder(shape) -> PartitionSpec``
+  — **spec-builder rules** (ISSUE 15): the FSDP table needs a
+  per-shape DIMENSION choice (shard a conv kernel's C_out, a bias's only
+  dim), which a fixed spec cannot express; the builder keeps the table
+  declarative while choosing the partitioned dim per leaf.
+
+Tables:
+
+- :func:`make_tp_rules` — the union of the per-family Megatron TP tables
+  (U-Net + ResNet/pix2pixHD/Expand trunks + PatchGAN chains), pinned
+  equal to the retired hand-built assignment (zero tp-diff gaps, CI-
+  grepped);
+- :func:`make_fsdp_rules` — ZeRO-style state sharding over the ``fsdp``
+  mesh axis: Adam moments (``opt_g/d/c``) and ``ema_g`` partition along
+  the data dimension (ZeRO-1); ``fsdp_params=True`` additionally shards
+  ``params_g/d/c`` (ZeRO-3-ish, gather-on-use left to GSPMD via the pjit
+  in/out shardings — no hand-written collectives anywhere);
+- :func:`trainstate_rules` composes them for a mesh: TP pairs claim
+  their leaves first (a TP-sharded moment mirrors its param shard), the
+  FSDP rules claim the rest of the optimizer/EMA state, a catch-all
+  replicates the remainder.
 
 Scalars (and 1-element leaves) never partition — the universal floor rule
 the snippets agree on.
@@ -33,32 +49,41 @@ the snippets agree on.
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from p2p_tpu.core.mesh import MODEL_AXIS
+from p2p_tpu.core.mesh import FSDP_AXIS, MODEL_AXIS
 
-#: ``(regex, PartitionSpec)`` or ``(regex, PartitionSpec, predicate)``
-#: entries, first match wins (re.search semantics; a predicate rule only
-#: matches when ``predicate(shape)`` is also true).
+#: ``(regex, spec_or_builder[, predicate])`` entries, first match wins
+#: (re.search semantics; a predicate rule only matches when
+#: ``predicate(shape)`` is also true; a callable spec is resolved per
+#: leaf as ``spec(shape)``).
 Rules = Sequence[Tuple]
 
 ShapePredicate = Callable[[Tuple[int, ...]], bool]
+SpecBuilder = Callable[[Tuple[int, ...]], P]
+SpecLike = Union[P, SpecBuilder]
 
 
-def rule_parts(rule) -> Tuple[str, P, Optional[ShapePredicate]]:
+def rule_parts(rule) -> Tuple[str, SpecLike, Optional[ShapePredicate]]:
     """Normalize a 2- or 3-tuple rule entry to ``(pattern, spec, pred)``."""
     if len(rule) == 2:
         return rule[0], rule[1], None
     pat, spec, pred = rule
     return pat, spec, pred
 
+
+def resolve_spec(spec: SpecLike, shape) -> P:
+    """A rule's concrete PartitionSpec for one leaf: fixed specs pass
+    through, spec builders are called with the leaf shape."""
+    return spec(tuple(shape)) if callable(spec) else spec
+
 #: The baseline table: fully-replicated state — correct for DP and for
 #: every mesh whose extra axes (spatial/time/pipe) shard activations, not
-#: parameters. TP layers its pair rule ON TOP via make_tp_rule.
+#: parameters. trainstate_rules layers the TP/FSDP tables ON TOP.
 REPLICATED_RULES: Rules = ((r".*", P()),)
 
 
@@ -100,7 +125,7 @@ def match_partition_rules(rules: Rules, tree: Any):
             pat, ps, pred = rule_parts(rule)
             if re.search(pat, name) is not None \
                     and (pred is None or pred(tuple(shape))):
-                return ps
+                return resolve_spec(ps, shape)
         tried = "; ".join(f"[{i}] {rule_parts(r)[0]!r}"
                           for i, r in enumerate(rules))
         raise ValueError(f"no partition rule matched leaf {name!r} "
@@ -113,22 +138,20 @@ def match_partition_rules(rules: Rules, tree: Any):
 
 def state_target_shardings(state: Any, mesh: Mesh,
                            rules: Optional[Rules] = None,
-                           tp_min_ch: int = 512):
-    """NamedSharding pytree: the restore-target layout of ``state`` on
-    ``mesh`` — the elastic resharded-restore's source of truth.
+                           tp_min_ch: int = 512,
+                           fsdp_params: bool = False):
+    """NamedSharding pytree: THE layout of ``state`` on ``mesh`` — the
+    single source of truth for trainer placement, serving placement, and
+    the elastic restore targets.
 
-    ``rules=None`` picks the layout the trainers actually run: the
-    Megatron TP tree when the mesh has a real model axis (delegating to
-    :func:`p2p_tpu.parallel.tp.tp_sharding_tree`, whose pair rule is
-    shape-conditional — outside the regex table's reach until rules grow
-    predicates), fully replicated otherwise.
+    ``rules=None`` derives the table from the mesh itself via
+    :func:`trainstate_rules`: Megatron TP pair shards when the ``model``
+    axis is real, ZeRO optimizer/EMA shards when the ``fsdp`` axis is
+    real (params too under ``fsdp_params``), replicated otherwise.
     """
     if rules is None:
-        if mesh.shape.get(MODEL_AXIS, 1) > 1:
-            from p2p_tpu.parallel.tp import tp_sharding_tree
-
-            return tp_sharding_tree(state, mesh, min_ch=tp_min_ch)
-        rules = REPLICATED_RULES
+        rules = trainstate_rules(dict(mesh.shape), tp_min_ch=tp_min_ch,
+                                 fsdp_params=fsdp_params)
     specs = match_partition_rules(rules, state)
     return jax.tree_util.tree_map(lambda ps: NamedSharding(mesh, ps), specs,
                                   is_leaf=lambda x: isinstance(x, P))
@@ -254,3 +277,84 @@ def tp_equivalence_rules(cfg, axis_size: int = 2,
         return (trunk + make_patchgan_tp_rules(axis_size, min_ch)
                 + ((r".*", P()),))
     return None
+
+
+# ---------------------------------------------------------------------------
+# The ONE partitioner (ISSUE 15): TP union + FSDP tables + composition.
+# ---------------------------------------------------------------------------
+
+
+def make_tp_rules(axis_size: int = 2, min_ch: int = 512) -> Tuple:
+    """The family-agnostic Megatron TP table: the UNION of every drained
+    family's predicate rules (the generator naming families are disjoint
+    — ``down3`` only exists in the U-Net, ``ConvLayer``/``ResnetBlock``
+    only in the ResNet trunks, ``scale\\d+`` only in the PatchGAN Ds — so
+    the union reproduces the retired hand-built assignment on ANY state
+    tree the repo builds; the per-preset zero-gap pins in
+    tests/test_analysis are the proof). No catch-all: this composes
+    inside :func:`trainstate_rules`."""
+    return (make_unet_tp_rules(axis_size, min_ch)
+            + make_resnet_tp_rules(axis_size, min_ch)
+            + make_patchgan_tp_rules(axis_size, min_ch))
+
+
+#: the TrainState fields the FSDP table shards (ZeRO-1: pure per-device
+#: replicated memory today — exactly what memory_budget.json quantifies).
+#: ``opt_s``/``pp_stages`` are deliberately absent: the PP stage stack
+#: shards over the ``pipe`` axis through parallel/pp.py's own machinery,
+#: and composing fsdp×pipe layouts is not expressible until a real mesh
+#: needs it.
+FSDP_STATE_RE = r"^(?:opt_[gdc]|ema_g)(?:/|$)"
+FSDP_PARAMS_RE = r"^params_[gdc](?:/|$)"
+
+
+def fsdp_shard_spec(axis_size: int, axis: str = FSDP_AXIS) -> SpecBuilder:
+    """Spec builder: partition the TRAILING divisible dim of a leaf over
+    ``axis`` (C_out on a conv kernel, the only dim of a bias/scale),
+    replicate when no dim divides — the ZeRO floor that keeps odd-width
+    leaves (a 3-channel image-head kernel's C_out) legal without
+    per-leaf wiring. Trailing-first keeps the partitioned dim the
+    channel dim wherever one exists, mirroring the TP convention."""
+    n = int(axis_size)
+
+    def spec(shape: Tuple[int, ...]) -> P:
+        for d in range(len(shape) - 1, -1, -1):
+            if shape[d] >= n and shape[d] % n == 0:
+                entries = [None] * len(shape)
+                entries[d] = axis
+                return P(*entries)
+        return P()
+
+    return spec
+
+
+def make_fsdp_rules(axis_size: int, fsdp_params: bool = False) -> Tuple:
+    """ZeRO-style state sharding over the ``fsdp`` mesh axis as TWO
+    spec-builder rules: Adam moments + EMA always (ZeRO-1 — the state
+    that is pure replicated HBM today), ``params_*`` behind the
+    ``fsdp_params`` knob (ZeRO-3-ish; GSPMD inserts the gather-on-use
+    from the pjit in/out shardings). Gradient reduce-scatter (ZeRO-2)
+    falls out for free: XLA sees sharded moment outputs and scatters the
+    grads feeding them instead of all-reducing."""
+    builder = fsdp_shard_spec(axis_size)
+    rules: Tuple = ((FSDP_STATE_RE, builder),)
+    if fsdp_params:
+        rules = ((FSDP_PARAMS_RE, builder),) + rules
+    return rules
+
+
+def trainstate_rules(axis_sizes: Dict[str, int], tp_min_ch: int = 512,
+                     fsdp_params: bool = False) -> Rules:
+    """THE rule table for a mesh topology (axis-name → size dict; no
+    devices needed, so hypothetical meshes audit/budget on one CPU):
+    TP pair rules first when the ``model`` axis is real (a TP-claimed
+    moment mirrors its param's channel shard), then the FSDP state rules
+    when the ``fsdp`` axis is real, then the replicate catch-all."""
+    rules: Tuple = ()
+    model = int(axis_sizes.get(MODEL_AXIS, 1) or 1)
+    if model > 1:
+        rules += make_tp_rules(model, tp_min_ch)
+    fsdp = int(axis_sizes.get(FSDP_AXIS, 1) or 1)
+    if fsdp > 1:
+        rules += make_fsdp_rules(fsdp, fsdp_params=fsdp_params)
+    return rules + ((r".*", P()),)

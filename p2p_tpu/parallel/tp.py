@@ -31,10 +31,13 @@ sharding region covers the ``spatial`` axis, not channel shards — under TP
 the XLA norm partitions natively, the Pallas custom call would force a
 channel all-gather.
 
-Round 6: this is a TRAINER capability, not just a library mechanism — the
-CLI trainer builds :func:`tp_sharding_tree` over the whole TrainState when
-``--mesh`` sets ``model > 1`` and jits the step with explicit in/out
-shardings (train/loop.py; ``--tp_min_ch`` plumbs ``min_ch``). CLI-TP ==
+Round 6 made this a TRAINER capability; ISSUE 15 retired the hand-built
+tree builder to a SHIM — the CLI trainer (and serving, and the elastic
+restore targets) now derive the whole-TrainState layout from the
+declarative tables in ``parallel/rules.py``
+(``state_target_shardings``), and :func:`tp_sharding_tree` below just
+delegates there. :func:`tp_leaf_spec` remains the REFERENCE assignment
+the tables are diffed against (the tp-diff zero-gap CI pin). CLI-TP ==
 single-device is pinned per-preset in tests/test_loop.py on top of the
 step-level equivalence tests here.
 
@@ -51,7 +54,7 @@ import re
 from typing import Any
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from p2p_tpu.core.mesh import MODEL_AXIS
 
@@ -155,26 +158,26 @@ def tp_leaf_spec(path_str: str, shape, axis_size: int,
                  min_ch: int = 512) -> P:
     """Pure-function view of the TP pair rule for ONE leaf: ``path_str``
     is the ``jax.tree_util.keystr`` path, ``axis_size`` the (possibly
-    hypothetical) model-axis width. No mesh, no devices — this is what the
-    sharding auditor's ``tp``-diff mode (p2p_tpu/analysis/sharding_audit)
-    compares against a declarative rule table to emit the ROADMAP item-3
-    migration worklist."""
+    hypothetical) model-axis width. No mesh, no devices.
+
+    This is the REFERENCE implementation the declarative tables were
+    drained against: the sharding auditor's ``tp``-diff mode
+    (p2p_tpu/analysis/sharding_audit) diffs it per leaf against
+    ``parallel/rules.py``'s tables, and the standing zero-gap CI pin is
+    what lets the live layouts run from the tables alone."""
     return _tp_spec(path_str, tuple(shape), axis_size, min_ch)
 
 
 def tp_sharding_tree(tree: Any, mesh: Mesh, min_ch: int = 512):
-    """NamedSharding pytree for ``tree``: Megatron-style channel shards on
-    ResnetBlock conv pairs wider than ``min_ch``, everything else
-    replicated. Works on a param tree, an optimizer state (adam's mu/nu
-    mirror the param paths), or a whole TrainState."""
-    size = mesh.shape.get(MODEL_AXIS, 1)
+    """RETIRED to a shim (ISSUE 15): delegates to the declarative rule
+    engine — ``parallel/rules.state_target_shardings`` over
+    ``trainstate_rules`` is the one sharding authority now (the zero
+    tp-diff gap pins guarantee the tables reproduce the hand-built
+    assignment this module used to compute). Kept only so historical
+    callers/tests keep meaning "the Megatron TP layout of this tree"."""
+    from p2p_tpu.parallel.rules import state_target_shardings
 
-    def rule(path, leaf):
-        ps = jax.tree_util.keystr(path)
-        shape = getattr(leaf, "shape", ())
-        return NamedSharding(mesh, _tp_spec(ps, shape, size, min_ch))
-
-    return jax.tree_util.tree_map_with_path(rule, tree)
+    return state_target_shardings(tree, mesh, tp_min_ch=min_ch)
 
 
 def place_state_tp(state: Any, mesh: Mesh, min_ch: int = 512):

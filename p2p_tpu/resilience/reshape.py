@@ -165,8 +165,13 @@ def elastic_restore(tr, step: int, plan: Optional[ElasticPlan]):
     if tr.mesh is not None:
         from p2p_tpu.parallel.rules import state_target_shardings
 
+        # the ONE partitioner: TP pair shards, ZeRO fsdp shards (an
+        # fsdp↔replicated delta lands here as a plain reshard — the
+        # Orbax load gathers or scatters the moments/EMA onto the new
+        # mesh's rule-derived targets, no transform needed)
         shardings = state_target_shardings(
-            template, tr.mesh, tp_min_ch=tr.cfg.parallel.tp_min_ch)
+            template, tr.mesh, tp_min_ch=tr.cfg.parallel.tp_min_ch,
+            fsdp_params=tr.cfg.parallel.fsdp_params)
     restored = tr.ckpt.restore(template, shardings=shardings)
     # integrity fallback may have landed on an OLDER intact step — the
     # transforms' audit records (and the dtype cast's regenerated

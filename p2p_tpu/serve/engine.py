@@ -129,18 +129,17 @@ class InferenceEngine:
         self._state_shardings = None
         self._batch_sharding = None
         if mesh is not None:
-            from p2p_tpu.core.mesh import MODEL_AXIS, batch_sharding, replicated
+            from p2p_tpu.core.mesh import batch_sharding
+            from p2p_tpu.parallel.rules import state_target_shardings
 
-            if mesh.shape.get(MODEL_AXIS, 1) > 1:
-                from p2p_tpu.parallel.tp import tp_sharding_tree
-
-                self._state_shardings = tp_sharding_tree(
-                    state, mesh,
-                    min_ch=(tp_min_ch if tp_min_ch is not None
-                            else cfg.parallel.tp_min_ch))
-            else:
-                self._state_shardings = jax.tree_util.tree_map(
-                    lambda _: replicated(mesh), state)
+            # the ONE partitioner (parallel/rules.py): Megatron TP when
+            # the mesh has a model axis, replicated otherwise — serving
+            # state has no optimizer, so an fsdp axis leaves it replicated
+            # (the catch-all) while batches still shard over it
+            self._state_shardings = state_target_shardings(
+                state, mesh,
+                tp_min_ch=(tp_min_ch if tp_min_ch is not None
+                           else cfg.parallel.tp_min_ch))
             state = jax.device_put(state, self._state_shardings)
             self._batch_sharding = batch_sharding(mesh)
         self.state = state

@@ -56,6 +56,7 @@ from p2p_tpu.obs import (
     RetraceWatchdog,
     SpanRecorder,
     add_sentinel_handler,
+    crosscheck_hbm_budget,
     write_manifest,
 )
 from p2p_tpu.resilience import Preempted, PreemptionGuard
@@ -105,6 +106,20 @@ def init_trainer_obs(tr) -> None:
 
         tr._sentinel_handler = _handler
         add_sentinel_handler(_handler)
+    # startup HBM cross-check (ISSUE 15): the state is placed but no step
+    # has compiled yet, so live bytes_in_use ≈ TrainState + the already-
+    # loaded VGG feature tree (extra_bytes — it precedes this check) —
+    # the one moment the static memory_budget.json law is directly
+    # observable. No-op on backends without memory stats (CPU CI); the
+    # static law models image TrainStates only, so the video trainer
+    # skips it.
+    if cfg.data.n_frames <= 1:
+        from p2p_tpu.train.state import tree_bytes
+
+        vgg = getattr(tr, "vgg_params", None)
+        crosscheck_hbm_budget(cfg, tr.mesh, registry=tr.obs,
+                              logger=tr.logger,
+                              extra_bytes=tree_bytes(vgg) if vgg else 0)
     # self-healing (resilience/health.py) rides the same wiring point:
     # both trainers get the sentinel + ladder when cfg.health.enabled
     init_trainer_health(tr)
@@ -865,12 +880,16 @@ class Trainer:
             build_trainer_mesh(cfg, workdir) if use_mesh else None
         )
         self._tp = False
+        self._fsdp = False
         if self.mesh is not None:
-            from p2p_tpu.core.mesh import MODEL_AXIS, PIPE_AXIS
+            from p2p_tpu.core.mesh import FSDP_AXIS, MODEL_AXIS, PIPE_AXIS
 
-            # model axis: the trainer builds the Megatron sharding tree
-            # below and trains genuinely tensor-parallel (parallel/tp.py)
+            # model axis: the rule tables shard the Megatron conv pairs
+            # and the trainer runs genuinely tensor-parallel; fsdp axis:
+            # the tables shard optimizer moments + EMA (and params under
+            # --fsdp_params) ZeRO-style (parallel/rules.py)
             self._tp = self.mesh.shape.get(MODEL_AXIS, 1) > 1
+            self._fsdp = self.mesh.shape.get(FSDP_AXIS, 1) > 1
             if self.mesh.shape.get(PIPE_AXIS, 1) > 1:
                 # training still runs correctly (the axis is just
                 # replicated) but those devices do duplicate work
@@ -954,15 +973,20 @@ class Trainer:
         )
         self.state_sharding = None
         if self.mesh is not None and self.mesh.size > 1:
-            if self._tp:
-                # CLI-TP: Megatron channel shards on the conv pairs the
-                # pair rule covers, everything else replicated; the same
-                # tree feeds make_parallel_train_step's in/out shardings
-                # so updated states STAY sharded across steps.
-                from p2p_tpu.parallel.tp import tp_sharding_tree
+            if self._tp or self._fsdp:
+                # The ONE partitioner (parallel/rules.py): Megatron
+                # channel shards on the TP conv pairs when model>1, ZeRO
+                # optimizer/EMA (± param) shards when fsdp>1, everything
+                # else replicated; the same tree feeds
+                # make_parallel_train_step's in/out shardings so updated
+                # states STAY sharded across steps — gather-on-use is
+                # GSPMD's job, no hand-written collectives.
+                from p2p_tpu.parallel.rules import state_target_shardings
 
-                self.state_sharding = tp_sharding_tree(
-                    self.state, self.mesh, min_ch=cfg.parallel.tp_min_ch)
+                self.state_sharding = state_target_shardings(
+                    self.state, self.mesh,
+                    tp_min_ch=cfg.parallel.tp_min_ch,
+                    fsdp_params=cfg.parallel.fsdp_params)
                 self.state = jax.device_put(self.state, self.state_sharding)
             else:
                 # Replicate the state over the mesh (as VideoTrainer does):
@@ -1329,10 +1353,10 @@ class Trainer:
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
-                from p2p_tpu.core.mesh import DATA_AXIS, SPATIAL_AXIS
+                from p2p_tpu.core.mesh import BATCH_AXES, SPATIAL_AXIS
 
                 stacked_sh = NamedSharding(
-                    self.mesh, P(None, DATA_AXIS, SPATIAL_AXIS, None, None)
+                    self.mesh, P(None, BATCH_AXES, SPATIAL_AXIS, None, None)
                 )
 
             def gen():

@@ -331,11 +331,11 @@ class VideoTrainer:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 from p2p_tpu.core.mesh import (
-                    DATA_AXIS, SPATIAL_AXIS, TIME_AXIS,
+                    BATCH_AXES, SPATIAL_AXIS, TIME_AXIS,
                 )
 
                 stacked_sh = NamedSharding(self.mesh, P(
-                    None, DATA_AXIS, TIME_AXIS, SPATIAL_AXIS, None, None
+                    None, BATCH_AXES, TIME_AXIS, SPATIAL_AXIS, None, None
                 ))
 
             def gen():

@@ -1724,3 +1724,112 @@ def test_int8_coverage_half_quantized_site_stays_on_worklist():
     assert len(wl) == 1 and "int8" in wl[0]["dtypes"]
     # ...and the cost model books the same eqn at the bf16 rate
     assert "int8" not in program_cost(jx)["mxu_flops_by_dtype"]
+
+
+# ------------------------------------------------ ISSUE 15: FSDP tables
+
+
+def test_spec_builder_rules_resolve_and_audit():
+    """Spec-builder rules (callable specs): match_partition_rules
+    resolves the builder per leaf, the audit fires/validates the
+    resolved specs, and a builder naming an absent axis is still an
+    unknown-axis ERROR (collected from the per-leaf resolutions)."""
+    from p2p_tpu.analysis.sharding_audit import (
+        RULE_DEAD,
+        RULE_UNKNOWN_AXIS,
+        audit_rules,
+    )
+    from p2p_tpu.parallel.rules import (
+        fsdp_shard_spec,
+        match_partition_rules,
+    )
+
+    tree = {"opt": {"k": np.zeros((3, 3, 8, 8)), "b": np.zeros((8,)),
+                    "odd": np.zeros((3,))},
+            "other": np.zeros((4, 4))}
+    rules = ((r"^opt/", fsdp_shard_spec(2)), (r".*", P()))
+    specs = match_partition_rules(rules, tree)
+    assert tuple(specs["opt"]["k"]) == (None, None, None, "fsdp")
+    assert tuple(specs["opt"]["b"]) == ("fsdp",)
+    assert specs["opt"]["odd"] == P()     # nothing divides 3 → replicate
+    assert specs["other"] == P()
+
+    mesh = {"data": 2, "fsdp": 2}
+    assert audit_rules(rules, tree, mesh) == []
+    # same table against a mesh WITHOUT the fsdp axis: error, named rule
+    bad = audit_rules(rules, tree, {"data": 2})
+    assert any(f.rule == RULE_UNKNOWN_AXIS and "spec builder" in f.message
+               for f in bad)
+    # a builder rule that fires on nothing is dead like any other
+    dead = audit_rules(((r"^nope/", fsdp_shard_spec(2)), (r".*", P())),
+                       tree, mesh)
+    assert any(f.rule == RULE_DEAD for f in dead)
+
+
+def test_fsdp_tables_audit_clean_over_presets():
+    """ISSUE 15 satellite: the composed family-TP + FSDP table audits
+    clean (no dead/shadowed rules, no unknown axes, no indivisible
+    shards) over the audited presets' full abstract TrainStates."""
+    from p2p_tpu.analysis.sharding_audit import (
+        abstract_train_state,
+        audit_rules,
+    )
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.parallel.rules import make_fsdp_rules, tp_equivalence_rules
+
+    mesh = {"data": 8, "fsdp": 2, "spatial": 2, "time": 1, "model": 2,
+            "pipe": 2}
+    for preset in ("facades", "pix2pixhd"):
+        cfg = get_preset(preset)
+        family = tp_equivalence_rules(cfg, 2, 512)
+        rules = (family[:-1] + make_fsdp_rules(2, fsdp_params=True)
+                 + ((r".*", P()),))
+        state = abstract_train_state(cfg)
+        assert audit_rules(rules, state, mesh) == [], preset
+
+
+def test_state_budget_fsdp_shards_opt_and_table_reduction():
+    """The ZeRO memory arithmetic, statically: the fsdp=4 facades row's
+    per-device optimizer bytes are ~1/4 of the replicated row's, the
+    budget table publishes opt_ema_reduction ≥ (axis-1)/axis − slack,
+    and params stay replicated without fsdp_params."""
+    from p2p_tpu.analysis.memory_audit import (
+        FSDP_REDUCTION_SLACK,
+        memory_budget_table,
+        state_budget,
+    )
+    from p2p_tpu.core.config import get_preset
+
+    cfg = get_preset("facades")
+    rep = state_budget(cfg, {"data": 1})
+    shd = state_budget(cfg, {"data": 1, "fsdp": 4})
+    assert shd["params"] == rep["params"]          # ZeRO-1: params whole
+    assert shd["opt"] <= rep["opt"] // 4 + 4096    # moments ~quartered
+    shd_p = state_budget(cfg, {"data": 1, "fsdp": 4}, fsdp_params=True)
+    assert shd_p["params"] < rep["params"]
+
+    rows, findings = memory_budget_table(
+        matrix=(("facades", ({"data": 1}, {"data": 1, "fsdp": 4})),))
+    fsdp_row = rows[1]
+    assert fsdp_row["fsdp_axis"] == 4
+    assert fsdp_row["opt_ema_reduction"] >= 0.75 - FSDP_REDUCTION_SLACK
+    assert not [f for f in findings if f.severity == ERROR]
+
+
+def test_memory_budget_fsdp_shortfall_fires(monkeypatch):
+    """The gate's negative: if the ZeRO rules stop sharding (simulated
+    by emptying the fsdp table), the fsdp row's reduction collapses and
+    memory-fsdp-shortfall fires as an ERROR."""
+    import p2p_tpu.parallel.rules as rules_mod
+    from p2p_tpu.analysis.memory_audit import (
+        RULE_FSDP_SHORTFALL,
+        memory_budget_table,
+    )
+
+    monkeypatch.setattr(rules_mod, "make_fsdp_rules",
+                        lambda axis_size, fsdp_params=False: ())
+    rows, findings = memory_budget_table(
+        matrix=(("facades", ({"data": 1}, {"data": 1, "fsdp": 4})),))
+    assert rows[1]["opt_ema_reduction"] == 0.0
+    hits = [f for f in findings if f.rule == RULE_FSDP_SHORTFALL]
+    assert hits and hits[0].severity == ERROR

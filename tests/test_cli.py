@@ -139,6 +139,23 @@ def test_mesh_flag_errors_are_clean():
         config_from_flags(build_parser().parse_args(["--mesh", "4x2x1"]))
     with pytest.raises(SystemExit):
         config_from_flags(build_parser().parse_args(["--mesh", "4,-1,1"]))
+    with pytest.raises(SystemExit):
+        config_from_flags(build_parser().parse_args(["--mesh", "zeta=2"]))
+
+
+def test_mesh_flag_named_form_and_fsdp_params():
+    """ISSUE 15: the named --mesh grammar addresses the fsdp axis, and
+    --fsdp_params lands on ParallelConfig."""
+    cfg = config_from_flags(build_parser().parse_args(
+        ["--mesh", "data=4,fsdp=2,model=2", "--fsdp_params"]))
+    m = cfg.parallel.mesh
+    assert (m.data, m.fsdp, m.model, m.spatial, m.time, m.pipe) \
+        == (4, 2, 2, 1, 1, 1)
+    assert cfg.parallel.fsdp_params is True
+    # positional form still parses and leaves fsdp at 1
+    cfg = config_from_flags(build_parser().parse_args(["--mesh", "2,2,1"]))
+    assert cfg.parallel.mesh.fsdp == 1
+    assert cfg.parallel.fsdp_params is False
 
 
 def test_generate_dataset_upsampling_is_scale_factor(tmp_path):

@@ -312,10 +312,45 @@ def test_state_target_shardings_replicates_by_default():
     assert sh["w"].spec == P() and sh["w"].mesh.shape["data"] == 2
 
 
+def test_classify_fsdp_delta_is_plain_reshard():
+    """ISSUE 15: an fsdp↔replicated delta is a pure LAYOUT change — the
+    restore lands the moments/EMA on the new mesh's rule-derived target
+    shardings, so the classifier files it under reshard, never abort."""
+    fsdp = _topo(mesh={"data": 2, "fsdp": 2, "spatial": 1, "time": 1,
+                       "model": 1, "pipe": 1})
+    d = classify_topology_delta(_topo(), fsdp)
+    assert d.kind == "reshard", d
+    assert "mesh.fsdp" in d.reason
+    # and back: fsdp-sharded checkpoint onto a replicated mesh
+    d = classify_topology_delta(fsdp, _topo())
+    assert d.kind == "reshard", d
+
+
+def test_state_target_shardings_fsdp_moments():
+    """The elastic restore-target law on an fsdp mesh: optimizer-moment
+    leaves land sharded over fsdp, scalars and params stay replicated
+    (fsdp_params off)."""
+    from p2p_tpu.parallel.rules import state_target_shardings
+
+    mesh = make_mesh(MeshSpec(data=1, fsdp=2), devices=jax.devices()[:2])
+    tree = {"opt_g": {"mu": {"k": np.zeros((3, 3, 8, 8))},
+                      "count": np.zeros((), np.int32)},
+            "params_g": {"k": np.zeros((3, 3, 8, 8))},
+            "ema_g": {"b": np.zeros((8,))}}
+    sh = state_target_shardings(tree, mesh)
+    assert tuple(sh["opt_g"]["mu"]["k"].spec) == (None, None, None, "fsdp")
+    assert sh["opt_g"]["count"].spec == P()
+    assert sh["params_g"]["k"].spec == P()
+    assert tuple(sh["ema_g"]["b"].spec) == ("fsdp",)
+    shp = state_target_shardings(tree, mesh, fsdp_params=True)
+    assert tuple(shp["params_g"]["k"].spec) == (None, None, None, "fsdp")
+
+
 # ----------------------------------------- the cross-topology resume pin
 
 
-def _elastic_cfg(data_axis: int, batch: int = 4, elastic: bool = True):
+def _elastic_cfg(data_axis: int, batch: int = 4, elastic: bool = True,
+                 fsdp_axis: int = 1):
     from p2p_tpu.core.config import (
         Config, DataConfig, LossConfig, ModelConfig, OptimConfig,
         ParallelConfig, TrainConfig,
@@ -330,7 +365,8 @@ def _elastic_cfg(data_axis: int, batch: int = 4, elastic: bool = True):
                         lambda_l1=100.0),
         optim=OptimConfig(niter=2, niter_decay=2),
         data=DataConfig(batch_size=batch, image_size=16, threads=0),
-        parallel=ParallelConfig(mesh=MeshSpec(data=data_axis)),
+        parallel=ParallelConfig(mesh=MeshSpec(data=data_axis,
+                                              fsdp=fsdp_axis)),
         train=TrainConfig(nepoch=2, epoch_save=2, log_every=100,
                           mixed_precision=False, seed=0,
                           eval_every_epoch=False, elastic=elastic),
@@ -426,6 +462,49 @@ def test_cross_mesh_resume_bitwise_equals_same_topology(
     assert el[0]["current"]["mesh"]["data"] == 4
     rs = [r for r in recs if r.get("kind") == "resharded_restore"]
     assert rs and rs[0]["resharded_restore_total"] >= 1
+
+
+def test_resume_replicated_onto_fsdp_mesh_bitwise(_preempted_run):
+    """ISSUE 15, the reverse gloo direction in-proc: the step-3
+    checkpoint written with a fully-replicated data=2 layout restores
+    onto a data=2 x fsdp=2 mesh as a plain reshard — the Orbax load
+    SCATTERS the optimizer moments onto the rule-derived ZeRO targets —
+    bitwise-equal to the same-topology restore, and the resumed run
+    completes on the fsdp mesh."""
+    from p2p_tpu.train.loop import Trainer
+
+    root, wd = _preempted_run
+
+    trc = Trainer(_elastic_cfg(2), data_root=root, workdir=wd)
+    assert trc.maybe_resume()
+    state_c = jax.device_get(trc.state)
+    trc.close()
+
+    trf = Trainer(_elastic_cfg(2, fsdp_axis=2), data_root=root, workdir=wd)
+    assert trf.maybe_resume()
+    assert trf.obs.counter("resharded_restore_total").value == 1
+    # the restored moments actually landed SHARDED over fsdp
+    mu = next(l for l in jax.tree_util.tree_leaves(trf.state.opt_g)
+              if getattr(l, "ndim", 0) == 4)
+    assert "fsdp" in str(mu.sharding.spec), mu.sharding
+    state_f = jax.device_get(trf.state)
+
+    leaves_f, td_f = jax.tree_util.tree_flatten(state_f)
+    leaves_c, td_c = jax.tree_util.tree_flatten(state_c)
+    assert td_f == td_c
+    for i, (f, c) in enumerate(zip(leaves_f, leaves_c)):
+        assert np.array_equal(np.asarray(f), np.asarray(c)), (
+            f"leaf {i} differs between fsdp- and same-topology restore")
+
+    try:
+        trf.fit()
+    finally:
+        trf.close()
+    assert int(np.asarray(jax.device_get(trf.state.step))) == 4
+    recs = _records(os.path.join(wd, "metrics_elastic.jsonl"))
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[0]["decision"] == "reshard"
+    assert "mesh.fsdp" in el[0]["reason"]
 
 
 def test_no_elastic_flag_restores_strict_contract(_preempted_run):

@@ -952,7 +952,7 @@ def test_quant_init_graft_arms_recalibrate_warmup(tmp_path):
 
 def test_int8_full_coverage_overlay():
     """core.config.int8_full_coverage: the ONE shared override set (lint
-    traced program == BENCH_INT8_FULL row) — coverage knobs on, stems
+    traced program == the facades_int8_full sweep row) — coverage knobs on, stems
     deliberately left to their measured-rejected default."""
     from p2p_tpu.core.config import get_preset, int8_full_coverage
 

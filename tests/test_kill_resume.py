@@ -525,3 +525,58 @@ def test_elastic_kill_resume_across_process_count_and_mesh(tmp_path):
         f"{steps}")
     epochs = [r for r in recs if r.get("kind") == "epoch"]
     assert len(epochs) == 1 and int(epochs[0]["epoch"]) == 1
+
+
+@pytest.mark.slow
+def test_elastic_kill_resume_fsdp_to_replicated(tmp_path):
+    """ISSUE 15 chaos rehearsal, cross-LAYOUT: a 2-process data=2 x
+    fsdp=2 run (ZeRO-sharded optimizer moments, the rule-driven
+    partitioner live end-to-end under gloo) is preempted at step 3, then
+    relaunched single-process on a plain data=2 mesh. The fsdp →
+    replicated delta must classify as a plain ``reshard`` (layout-only —
+    the Orbax load gathers the moment shards onto the replicated
+    targets), finish rc=0, and the per-process step union stays gapless
+    1..steps_per_epoch."""
+    n_train = 24          # bs 4 → 6 steps/epoch; kill at step 3
+    root = make_synthetic_dataset(str(tmp_path / "data"), n_train, 2, size=16)
+    wd = str(tmp_path / "w")
+    os.makedirs(wd)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [
+        "--preset", "facades", "--data_root", root, "--workdir", wd,
+        "--name", "ef", "--dataset", "efsynth",
+        "--image_size", "16", "--batch_size", "4", "--test_batch_size", "2",
+        "--ngf", "4", "--ndf", "4", "--threads", "0",
+        "--nepoch", "1", "--niter", "1", "--niter_decay", "0",
+        "--epochsave", "1", "--seed", "0", "--lambda_vgg", "0",
+        "--log_every", "1",
+    ]
+    # 2 procs × 2 devices → data=2 × fsdp=2 (the named --mesh grammar)
+    env = _gloo_phase_a(tmp_path, wd, args, repo, "--mesh=data=-1,fsdp=2")
+    ckpt_dir = os.path.join(wd, "checkpoint", "efsynth", "ef")
+    with open(os.path.join(ckpt_dir + ".aux", "3.json")) as f:
+        topo = json.load(f)["topology"]
+    assert topo["mesh"]["fsdp"] == 2
+
+    env_b = dict(env)
+    env_b.pop("P2P_CHAOS", None)
+    out2 = subprocess.run(
+        [sys.executable, "-c", _SHIM, *args, "--mesh", "2,1,1"],
+        env=env_b, capture_output=True, text=True, timeout=540, cwd=repo,
+    )
+    assert out2.returncode == 0, out2.stdout[-3000:] + out2.stderr[-2000:]
+    assert "elastic resume" in out2.stdout
+
+    recs = [json.loads(line)
+            for line in open(os.path.join(wd, "metrics_ef.jsonl"))]
+    el = [r for r in recs if r.get("kind") == "elastic_resume"]
+    assert el and el[0]["decision"] == "reshard", el
+    assert "mesh.fsdp" in el[0]["reason"]
+    rs = [r for r in recs if r.get("kind") == "resharded_restore"]
+    assert rs and rs[0]["resharded_restore_total"] >= 1
+    steps = sorted(set(_all_train_steps(wd, "ef")))
+    spe = n_train // 4
+    assert steps == list(range(1, spe + 1)), (
+        f"step gaps/repeats across the fsdp relaunch: {steps}")
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    assert len(epochs) == 1 and int(epochs[0]["epoch"]) == 1

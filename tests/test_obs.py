@@ -357,7 +357,8 @@ def test_trainer_obs_wiring(tmp_path, monkeypatch):
 
         manifest = json.load(open(tmp_path / "manifest_obswire.json"))
         assert manifest["config_hash"] == obs.config_hash(cfg)
-        assert manifest["mesh_shape"] == {"data": 1, "spatial": 1, "time": 1,
+        assert manifest["mesh_shape"] == {"data": 1, "fsdp": 1,
+                                          "spatial": 1, "time": 1,
                                           "model": 1, "pipe": 1}
 
         recs = [json.loads(x) for x in open(tmp_path / "metrics_obswire.jsonl")]
@@ -502,3 +503,59 @@ def test_retrace_watchdog_persistent_cache_hit_on_identical_compile(
         w.close()
         cache_mod._enabled_dir = prev_enabled
         jax.config.update("jax_compilation_cache_dir", prev_dir)
+
+
+def test_budget_drift_pure_comparison():
+    from p2p_tpu.obs import budget_drift
+
+    drift, bad = budget_drift(110, 100)
+    assert abs(drift - 0.10) < 1e-9 and not bad
+    drift, bad = budget_drift(125, 100)
+    assert bad and abs(drift - 0.25) < 1e-9
+    assert budget_drift(0, 0) == (0.0, False)   # no static row → no claim
+
+
+def test_crosscheck_hbm_budget_record_and_warn(capsys):
+    """ISSUE 15 satellite: the startup cross-check compares the live
+    per-host HBM fill against the static memory_budget.json state law,
+    publishes gauges + a kind="hbm_budget" record, and warns past 10%
+    drift. Driven with injected samples (CPU devices report no memory
+    stats)."""
+    import dataclasses
+
+    from p2p_tpu.analysis.memory_audit import state_budget
+    from p2p_tpu.core.config import get_preset
+    from p2p_tpu.obs import MetricsRegistry, crosscheck_hbm_budget
+
+    cfg = get_preset("facades")
+    cfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, ngf=8, ndf=8),
+        data=dataclasses.replace(cfg.data, image_size=16))
+    static = state_budget(cfg, {})["state_total"]
+
+    class _Log:
+        def __init__(self):
+            self.recs = []
+
+        def log(self, rec, force=False):
+            self.recs.append(rec)
+
+    # no samples at all (CPU backend): a no-op returning None
+    assert crosscheck_hbm_budget(cfg, None, samples={}) is None
+
+    reg, log = MetricsRegistry(), _Log()
+    rec = crosscheck_hbm_budget(
+        cfg, None, registry=reg, logger=log,
+        samples={"0": {"bytes_in_use": int(static * 1.02)}})
+    assert rec is not None and not rec["out_of_band"]
+    assert rec["static_state_bytes"] == static
+    assert log.recs and log.recs[0]["kind"] == "hbm_budget"
+    assert reg.gauge("hbm_budget_state_bytes").value == static
+    assert "WARNING" not in capsys.readouterr().out
+
+    rec = crosscheck_hbm_budget(
+        cfg, None, registry=reg, logger=log,
+        samples={"0": {"bytes_in_use": int(static * 1.5)}})
+    assert rec["out_of_band"] and rec["drift"] > 0.10
+    assert reg.counter("hbm_budget_drift_total").value == 1
+    assert "static memory model" in capsys.readouterr().out
